@@ -1,0 +1,12 @@
+"""etcd_tpu: a TPU-native batched Raft consensus simulation engine.
+
+The capabilities of etcd's `raft/` stack (reference: Monokaix/etcd),
+re-designed TPU-first: vmapped pure step functions over [clusters, members]
+struct-of-arrays state, dense message tensors exchanged by transpose /
+collectives, and fault injection as keep-masks. See SURVEY.md at the repo
+root for the full mapping to the reference.
+"""
+from etcd_tpu.types import Spec
+from etcd_tpu.utils.config import RaftConfig
+
+__all__ = ["Spec", "RaftConfig"]

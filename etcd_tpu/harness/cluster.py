@@ -1,0 +1,167 @@
+"""rafttest-style host driver over the batched engine.
+
+Plays the role of the reference's synchronous fake network
+(``type network`` in raft/raft_test.go:4633-4748: send-to-quiescence,
+drop/cut/isolate/recover) and of the rafttest InteractionEnv verbs
+(campaign/propose/stabilize, raft/rafttest/interaction_env_handler.go).
+All C clusters advance in lockstep; the per-link fault state is the
+engine's keep-mask.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from etcd_tpu.models.engine import RaftEngine
+from etcd_tpu.types import ENTRY_CONF_CHANGE, ENTRY_NORMAL, NONE_ID, ROLE_LEADER, Spec
+from etcd_tpu.utils.config import RaftConfig
+
+
+class Cluster:
+    def __init__(
+        self,
+        n_members: int = 3,
+        C: int = 1,
+        spec: Spec | None = None,
+        cfg: RaftConfig = RaftConfig(),
+        voters=None,
+        learners=None,
+        seed: int = 0,
+    ):
+        spec = spec or Spec(M=n_members)
+        if voters is not None:
+            voters = jnp.asarray(voters, jnp.bool_)
+        if learners is not None:
+            learners = jnp.asarray(learners, jnp.bool_)
+        self.eng = RaftEngine(spec, cfg, C, voters, learners, seed)
+        self.spec, self.cfg, self.C = spec, cfg, C
+        self._next_ctx = 1
+        self._reset_inputs()
+
+    # -- queued inputs applied on the next round ----------------------------
+    def _reset_inputs(self):
+        C, M, E = self.C, self.spec.M, self.spec.E
+        self._hup = np.zeros((C, M), bool)
+        self._plen = np.zeros((C, M), np.int32)
+        self._pdata = np.zeros((C, M, E), np.int32)
+        self._ptype = np.zeros((C, M, E), np.int32)
+        self._rictx = np.zeros((C, M), np.int32)
+
+    def campaign(self, m: int, c: int = 0):
+        self._hup[c, m] = True
+
+    def propose(self, m: int, data: int, c: int = 0):
+        """Queue one normal-entry proposal at node m."""
+        i = int(self._plen[c, m])
+        if i >= self.spec.E:
+            raise ValueError("proposal batch full for this round")
+        self._pdata[c, m, i] = data
+        self._ptype[c, m, i] = ENTRY_NORMAL
+        self._plen[c, m] = i + 1
+
+    def propose_conf_change(self, m: int, data: int, c: int = 0):
+        i = int(self._plen[c, m])
+        self._pdata[c, m, i] = data
+        self._ptype[c, m, i] = ENTRY_CONF_CHANGE
+        self._plen[c, m] = i + 1
+
+    def read_index(self, m: int, c: int = 0) -> int:
+        ctx = self._next_ctx
+        self._next_ctx += 1
+        self._rictx[c, m] = ctx
+        return ctx
+
+    # -- faults (raft_test.go:4722-4748) ------------------------------------
+    def isolate(self, m: int, c: int | None = None):
+        km = np.asarray(self.eng.keep_mask)
+        cs = slice(None) if c is None else c
+        km[cs, m, :] = False
+        km[cs, :, m] = False
+        self.eng.keep_mask = jnp.asarray(km)
+
+    def cut(self, a: int, b: int, c: int | None = None):
+        km = np.asarray(self.eng.keep_mask)
+        cs = slice(None) if c is None else c
+        km[cs, a, b] = False
+        km[cs, b, a] = False
+        self.eng.keep_mask = jnp.asarray(km)
+
+    def partition(self, groups: list[list[int]], c: int | None = None):
+        """Only links within the same group stay up."""
+        M = self.spec.M
+        km = np.zeros((M, M), bool)
+        for g in groups:
+            for a in g:
+                for b in g:
+                    km[a, b] = True
+        full = np.asarray(self.eng.keep_mask)
+        cs = slice(None) if c is None else c
+        full[cs] = km
+        self.eng.keep_mask = jnp.asarray(full)
+
+    def recover(self, c: int | None = None):
+        km = np.asarray(self.eng.keep_mask)
+        cs = slice(None) if c is None else c
+        km[cs] = True
+        self.eng.keep_mask = jnp.asarray(km)
+
+    # -- stepping ------------------------------------------------------------
+    def step(self, tick: bool = False):
+        self.eng.step(
+            prop_len=self._plen,
+            prop_data=self._pdata,
+            prop_type=self._ptype,
+            ri_ctx=self._rictx,
+            do_hup=self._hup,
+            do_tick=tick,
+        )
+        self._reset_inputs()
+
+    def tick(self, rounds: int = 1):
+        for _ in range(rounds):
+            self.step(tick=True)
+
+    def stabilize(self, max_rounds: int = 64, tick: bool = False):
+        """Deliver cascades to quiescence (network.send's loop-to-empty,
+        raft_test.go:4713-4720)."""
+        self.step(tick=tick)
+        for _ in range(max_rounds):
+            if self.eng.pending_messages() == 0:
+                break
+            self.step(tick=tick)
+        return self
+
+    # -- inspection ----------------------------------------------------------
+    @property
+    def s(self):
+        return self.eng.state
+
+    def np_(self, leaf) -> np.ndarray:
+        return np.asarray(leaf)
+
+    def roles(self, c: int = 0) -> np.ndarray:
+        return np.asarray(self.s.role[c])
+
+    def leader(self, c: int = 0) -> int:
+        lead = np.asarray(self.s.role[c]) == ROLE_LEADER
+        ids = np.nonzero(lead)[0]
+        return int(ids[0]) if len(ids) else NONE_ID
+
+    def terms(self, c: int = 0) -> np.ndarray:
+        return np.asarray(self.s.term[c])
+
+    def commits(self, c: int = 0) -> np.ndarray:
+        return np.asarray(self.s.commit[c])
+
+    def log_entries(self, m: int, c: int = 0) -> list[tuple[int, int]]:
+        """[(term, data), ...] for indexes (snap, last]."""
+        s = self.s
+        last = int(s.last_index[c, m])
+        snap = int(s.snap_index[c, m])
+        lt = np.asarray(s.log_term[c, m])
+        ld = np.asarray(s.log_data[c, m])
+        out = []
+        for i in range(snap + 1, last + 1):
+            sl = (i - 1) % self.spec.L
+            out.append((int(lt[sl]), int(ld[sl])))
+        return out

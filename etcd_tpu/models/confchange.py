@@ -1,0 +1,160 @@
+"""Config-change arithmetic as mask algebra.
+
+Re-expression of the reference's ``confchange.Changer`` (raft/confchange/
+confchange.go): Simple one-delta changes (confchange.go:130-147), joint
+consensus EnterJoint/LeaveJoint (49-123) and LearnersNext staging (206-230),
+operating on bool[M] masks instead of map-backed ProgressMaps. A conf change
+is encoded into a single int32 entry-data word (up to two changes, which
+covers the V2 auto-joint rule "more than one change => joint").
+
+Word layout (low bits first):
+  [0:3]   op1 (CC_*)        [3:8]   id1
+  [8:11]  op2               [11:16] id2
+  16: has1   17: has2   18: enter_joint   19: auto_leave   20: leave_joint
+
+The validation the reference performs in Changer.checkInvariants is enforced
+at proposal time by the leader-side guards in stepLeader (one unapplied
+change at a time, no new change while joint, leave only while joint), so
+application here is unconditional — matching applyConfChange's panic-on-
+invalid contract (raft.go:1623-1643).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from etcd_tpu.types import (
+    CC_ADD_LEARNER,
+    CC_ADD_NODE,
+    CC_REMOVE_NODE,
+    NONE_ID,
+    ROLE_LEADER,
+)
+from etcd_tpu.utils.tree import tree_where
+
+_HAS1 = 1 << 16
+_HAS2 = 1 << 17
+_ENTER = 1 << 18
+_AUTO = 1 << 19
+_LEAVE = 1 << 20
+
+
+def encode(
+    changes: list[tuple[int, int]],
+    enter_joint: bool = False,
+    auto_leave: bool = True,
+    leave_joint: bool = False,
+) -> int:
+    """Host-side encoder: changes is [(op, id), ...] with at most 2 entries."""
+    if leave_joint:
+        return _LEAVE
+    if len(changes) > 2:
+        raise ValueError("at most 2 changes per conf-change word")
+    w = 0
+    if len(changes) >= 1:
+        op, nid = changes[0]
+        w |= (op & 7) | ((nid & 31) << 3) | _HAS1
+    if len(changes) >= 2:
+        op, nid = changes[1]
+        w |= ((op & 7) << 8) | ((nid & 31) << 11) | _HAS2
+    if enter_joint or len(changes) > 1:
+        w |= _ENTER
+        if auto_leave:
+            w |= _AUTO
+    return w
+
+
+def encode_leave_joint() -> int:
+    return _LEAVE
+
+
+def is_leave_joint(data) -> jnp.ndarray:
+    return (data & _LEAVE) != 0
+
+
+def _apply_one(spec, v, vo, l, ln_, joint, op, nid, enable):
+    """One change against the incoming config (confchange.go:152-230)."""
+    hot = (jnp.arange(spec.M, dtype=jnp.int32) == nid) & enable
+    add_v = hot & (op == CC_ADD_NODE)
+    add_l = hot & (op == CC_ADD_LEARNER)
+    rem = hot & (op == CC_REMOVE_NODE)
+    # makeVoter (confchange.go:152-164)
+    v = (v | add_v) & ~add_l & ~rem
+    # makeLearner (confchange.go:166-230): a demoted voter still in the
+    # outgoing config is staged in LearnersNext until LeaveJoint
+    stage = add_l & joint & vo
+    l = (l | (add_l & ~stage)) & ~add_v & ~rem
+    ln_ = (ln_ | stage) & ~add_v & ~rem
+    return v, vo, l, ln_
+
+
+def apply_conf_change(cfg, spec, n, ob, data, enable):
+    """applyConfChange + switchToConfig (raft/raft.go:1623-1700)."""
+    from etcd_tpu.models import raft as raftmod  # cycle-free at call time
+
+    op1 = data & 7
+    id1 = (data >> 3) & 31
+    op2 = (data >> 8) & 7
+    id2 = (data >> 11) & 31
+    has1 = (data & _HAS1) != 0
+    has2 = (data & _HAS2) != 0
+    enter = ((data & _ENTER) != 0) | (has1 & has2)
+    auto = (data & _AUTO) != 0
+    leave = (data & _LEAVE) != 0
+
+    v, vo, l, ln_ = n.voters, n.voters_out, n.learners, n.learners_next
+
+    # LeaveJoint (confchange.go:97-123)
+    do_leave = enable & leave
+    v_l = v
+    l_l = l | ln_
+    ln_l = jnp.zeros_like(ln_)
+    vo_l = jnp.zeros_like(vo)
+
+    # EnterJoint copies incoming -> outgoing first (confchange.go:49-95)
+    do_change = enable & ~leave
+    vo_c = jnp.where(do_change & enter, v, vo)
+    joint_now = vo_c.any()
+    v_c, vo_c, l_c, ln_c = _apply_one(
+        spec, v, vo_c, l, ln_, joint_now, op1, id1, do_change & has1
+    )
+    v_c, vo_c, l_c, ln_c = _apply_one(
+        spec, v_c, vo_c, l_c, ln_c, joint_now, op2, id2, do_change & has2
+    )
+
+    n = n.replace(
+        voters=jnp.where(do_leave, v_l, jnp.where(do_change, v_c, n.voters)),
+        voters_out=jnp.where(do_leave, vo_l, jnp.where(do_change, vo_c, n.voters_out)),
+        learners=jnp.where(do_leave, l_l, jnp.where(do_change, l_c, n.learners)),
+        learners_next=jnp.where(
+            do_leave, ln_l, jnp.where(do_change, ln_c, n.learners_next)
+        ),
+        auto_leave=jnp.where(
+            do_leave, False, jnp.where(do_change & enter, auto, n.auto_leave)
+        ),
+    )
+
+    # switchToConfig side effects (raft.go:1651-1700)
+    from etcd_tpu.models.state import in_config_self, is_learner_self
+
+    self_ok = in_config_self(n) & ~is_learner_self(n)
+    active = (
+        enable & (n.role == ROLE_LEADER) & self_ok & n.voters.any()
+    )
+    n2, adv = raftmod.maybe_commit_state(cfg, spec, n)
+    n = tree_where(active & adv, n2, n)
+    n, ob = raftmod.bcast_append(cfg, spec, n, ob, active & adv)
+    n, ob = raftmod.maybe_send_append(
+        cfg,
+        spec,
+        n,
+        ob,
+        raftmod._progress_ids(n) & jnp.broadcast_to(active & ~adv, (spec.M,)),
+        False,
+    )
+    # abort a transfer to a peer no longer in the voter union (raft.go:1694-1697)
+    tr = jnp.clip(n.lead_transferee, 0, spec.M - 1)
+    gone = (n.lead_transferee != NONE_ID) & ~(n.voters | n.voters_out)[tr]
+    n = n.replace(
+        lead_transferee=jnp.where(enable & gone, NONE_ID, n.lead_transferee)
+    )
+    return n, ob

@@ -1,0 +1,166 @@
+"""Batched engine: vmapped node rounds + message exchange.
+
+The reference runs one goroutine per node and moves messages through
+rafthttp streams (server/etcdserver/api/rafthttp/). Here a fleet of
+``C x M`` nodes steps in lockstep: ``jax.vmap`` over members then clusters
+turns the per-node round into one fused XLA program, and the "network" is a
+transpose of the dense outbox tensor ``[C, from, to, K] -> [C, to, from, K]``
+with a multiplicative keep-mask standing in for drop/partition faults
+(rafttest/network.go:33-64's drop/disconnect semantics; dropping is legal
+per the transport contract, etcdserver/raft.go:107-110).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from etcd_tpu.models.raft import node_round
+from etcd_tpu.models.state import NodeState, init_node
+from etcd_tpu.ops.outbox import Outbox
+from etcd_tpu.types import Msg, Spec
+from etcd_tpu.utils.config import RaftConfig
+
+
+def empty_inbox(spec: Spec, C: int) -> Msg:
+    """Zeroed inbox [C, to, from, K]."""
+    from etcd_tpu.types import empty_msg
+
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(
+            x, (C, spec.M, spec.M, spec.K) + x.shape
+        ),
+        empty_msg(spec),
+    )
+
+
+def init_fleet(
+    spec: Spec,
+    C: int,
+    voters: jnp.ndarray | None = None,
+    learners: jnp.ndarray | None = None,
+    seed: int = 0,
+) -> NodeState:
+    """State pytree with leading [C, M] axes. `voters`/`learners` may be
+    [M] (shared) or [C, M] masks."""
+    if voters is None:
+        voters = jnp.ones((spec.M,), jnp.bool_)
+    if voters.ndim == 1:
+        voters = jnp.broadcast_to(voters, (C, spec.M))
+    if learners is None:
+        learners = jnp.zeros((C, spec.M), jnp.bool_)
+    elif learners.ndim == 1:
+        learners = jnp.broadcast_to(learners, (C, spec.M))
+
+    def one(c, m):
+        return init_node(
+            spec, m, voters[c], learners[c], seed=c * 1_000_003 + seed
+        )
+
+    return jax.vmap(
+        lambda c: jax.vmap(lambda m: one(c, m))(jnp.arange(spec.M, dtype=jnp.int32))
+    )(jnp.arange(C, dtype=jnp.int32))
+
+
+def build_round(cfg: RaftConfig, spec: Spec):
+    """Returns round_fn(state, inbox, prop_len, prop_data, prop_type,
+    ri_ctx, do_hup, do_tick, keep_mask) -> (state, next_inbox).
+
+    Shapes: state/* leaves [C, M, ...]; inbox leaves [C, M, M, K, ...];
+    prop_len/ri_ctx/do_hup/do_tick [C, M]; prop_data/prop_type [C, M, E];
+    keep_mask [C, M(from), M(to)] bool (True = deliver).
+    """
+    node_fn = functools.partial(node_round, cfg, spec)
+    vmapped = jax.vmap(jax.vmap(node_fn))
+
+    def round_fn(
+        state: NodeState,
+        inbox: Msg,
+        prop_len,
+        prop_data,
+        prop_type,
+        ri_ctx,
+        do_hup,
+        do_tick,
+        keep_mask,
+    ):
+        state, ob = vmapped(
+            state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup, do_tick
+        )
+        msgs = ob.msgs  # leaves [C, from, to, K, ...]
+        msgs = msgs.replace(
+            type=jnp.where(keep_mask[..., None], msgs.type, 0)
+        )
+        next_inbox = jax.tree.map(lambda x: jnp.swapaxes(x, 1, 2), msgs)
+        return state, next_inbox
+
+    return round_fn
+
+
+class RaftEngine:
+    """Jitted lockstep driver for a fleet of C x M-member Raft groups."""
+
+    def __init__(
+        self,
+        spec: Spec = Spec(),
+        cfg: RaftConfig = RaftConfig(),
+        C: int = 1,
+        voters=None,
+        learners=None,
+        seed: int = 0,
+    ):
+        self.spec, self.cfg, self.C = spec, cfg, C
+        self.state = init_fleet(spec, C, voters, learners, seed)
+        self.inbox = empty_inbox(spec, C)
+        self.keep_mask = jnp.ones((C, spec.M, spec.M), jnp.bool_)
+        self._round = jax.jit(build_round(cfg, spec))
+
+    # -- one lockstep round -------------------------------------------------
+    def step(
+        self,
+        prop_len=None,
+        prop_data=None,
+        prop_type=None,
+        ri_ctx=None,
+        do_hup=None,
+        do_tick=False,
+    ):
+        C, M, E = self.C, self.spec.M, self.spec.E
+        z2 = jnp.zeros((C, M), jnp.int32)
+        prop_len = z2 if prop_len is None else jnp.asarray(prop_len, jnp.int32)
+        prop_data = (
+            jnp.zeros((C, M, E), jnp.int32)
+            if prop_data is None
+            else jnp.asarray(prop_data, jnp.int32)
+        )
+        prop_type = (
+            jnp.zeros((C, M, E), jnp.int32)
+            if prop_type is None
+            else jnp.asarray(prop_type, jnp.int32)
+        )
+        ri_ctx = z2 if ri_ctx is None else jnp.asarray(ri_ctx, jnp.int32)
+        do_hup = (
+            jnp.zeros((C, M), jnp.bool_)
+            if do_hup is None
+            else jnp.asarray(do_hup, jnp.bool_)
+        )
+        if isinstance(do_tick, bool):
+            do_tick = jnp.full((C, M), do_tick, jnp.bool_)
+        else:
+            do_tick = jnp.asarray(do_tick, jnp.bool_)
+        self.state, self.inbox = self._round(
+            self.state,
+            self.inbox,
+            prop_len,
+            prop_data,
+            prop_type,
+            ri_ctx,
+            do_hup,
+            do_tick,
+            self.keep_mask,
+        )
+        return self.state
+
+    def pending_messages(self) -> int:
+        return int((self.inbox.type != 0).sum())

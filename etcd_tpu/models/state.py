@@ -1,0 +1,202 @@
+"""Per-node Raft state as a struct-of-arrays pytree.
+
+This is the TPU-native re-layout of the reference's ``raft`` struct
+(raft/raft.go:243-316) fused with its ``raftLog`` (raft/log.go:24-45),
+``tracker.ProgressTracker`` (tracker/tracker.go) and config masks
+(tracker.Config / confchange): one node's state is a bundle of scalars,
+[M] peer-arrays and an [L] log ring; a whole fleet is the same pytree with
+leading ``[clusters, members]`` axes produced by ``jax.vmap``.
+
+Design notes vs the reference:
+  * stable/unstable log split (raft/log_unstable.go) collapses to cursor
+    arithmetic — the device ring IS the log; host checkpointing reads any
+    suffix it wants. `first_index = snap_index + 1`, valid range
+    (snap_index, last_index], capacity L.
+  * Snapshots are applied eagerly on restore (the reference stages them in
+    `unstable.snapshot` until the app applies them; our "application" is
+    fused into the round step), so `promotable()`'s pending-snapshot check
+    (raft/raft.go:1618-1621) is vacuously satisfied.
+  * The applied state machine is a rolling hash chain (`applied_hash`) —
+    the batched analog of the functional tester's KV_HASH checker
+    (tests/functional/tester/checker_kv_hash.go): two nodes with equal
+    `applied` must have equal `applied_hash`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from etcd_tpu.types import (
+    NONE_ID,
+    PR_PROBE,
+    ROLE_FOLLOWER,
+    Spec,
+)
+
+
+class NodeState(struct.PyTreeNode):
+    # --- identity -----------------------------------------------------------
+    nid: jnp.ndarray          # i32, this node's member id (constant)
+
+    # --- HardState (raftpb.HardState, raft.proto:102-106) -------------------
+    term: jnp.ndarray         # i32
+    vote: jnp.ndarray         # i32, NONE_ID if none
+    commit: jnp.ndarray       # i32
+
+    # --- SoftState ----------------------------------------------------------
+    lead: jnp.ndarray         # i32, NONE_ID if unknown
+    role: jnp.ndarray         # i32 ROLE_*
+
+    # --- log ring (raftLog + unstable fused) --------------------------------
+    log_term: jnp.ndarray     # i32[L]
+    log_data: jnp.ndarray     # i32[L]
+    log_type: jnp.ndarray     # i32[L] ENTRY_*
+    last_index: jnp.ndarray   # i32
+    applied: jnp.ndarray      # i32
+    applied_hash: jnp.ndarray # i32 rolling hash chain of applied entries
+
+    # --- snapshot (raftpb.SnapshotMetadata analog) --------------------------
+    snap_index: jnp.ndarray   # i32; log holds (snap_index, last_index]
+    snap_term: jnp.ndarray    # i32
+    snap_hash: jnp.ndarray    # i32 applied_hash at snap_index
+    snap_voters: jnp.ndarray        # bool[M] ConfState at snapshot
+    snap_voters_out: jnp.ndarray    # bool[M]
+    snap_learners: jnp.ndarray      # bool[M]
+    snap_learners_next: jnp.ndarray # bool[M]
+    snap_auto_leave: jnp.ndarray    # bool
+
+    # --- timers (raft.go:285-303) -------------------------------------------
+    election_elapsed: jnp.ndarray    # i32
+    heartbeat_elapsed: jnp.ndarray   # i32
+    randomized_timeout: jnp.ndarray  # i32
+    rng_key: jnp.ndarray             # u32[2] per-node PRNG key
+
+    # --- leader replication tracker (tracker/progress.go:30-80) -------------
+    match: jnp.ndarray        # i32[M]
+    next_idx: jnp.ndarray     # i32[M]
+    pr_state: jnp.ndarray     # i32[M] PR_*
+    probe_sent: jnp.ndarray   # bool[M]
+    pending_snapshot: jnp.ndarray  # i32[M]
+    recent_active: jnp.ndarray     # bool[M]
+    # inflights ring (tracker/inflights.go): ends of in-flight MsgApps
+    infl_ends: jnp.ndarray    # i32[M, W]
+    infl_start: jnp.ndarray   # i32[M]
+    infl_count: jnp.ndarray   # i32[M]
+
+    # --- votes (tracker.ProgressTracker.Votes) ------------------------------
+    votes_responded: jnp.ndarray  # bool[M]
+    votes_granted: jnp.ndarray    # bool[M]
+
+    # --- config: this node's applied view (tracker.Config) ------------------
+    voters: jnp.ndarray           # bool[M] incoming voters
+    voters_out: jnp.ndarray       # bool[M] outgoing voters (joint iff any)
+    learners: jnp.ndarray         # bool[M]
+    learners_next: jnp.ndarray    # bool[M]
+    auto_leave: jnp.ndarray       # bool
+
+    # --- leader bookkeeping -------------------------------------------------
+    pending_conf_index: jnp.ndarray  # i32
+    uncommitted_size: jnp.ndarray    # i32 (entry count stand-in for bytes)
+    lead_transferee: jnp.ndarray     # i32
+
+    # --- read-only queue (raft/read_only.go), re-keyed by int ctx -----------
+    ro_ctx: jnp.ndarray       # i32[R] request ctx ids (0 = empty)
+    ro_index: jnp.ndarray     # i32[R] commit index captured at enqueue
+    ro_from: jnp.ndarray      # i32[R] requester id (NONE_ID/self => local)
+    ro_acks: jnp.ndarray      # bool[R, M]
+    ro_count: jnp.ndarray     # i32 number of queued requests
+    # pending MsgReadIndex deferred until first commit in term
+    # (raft.go:311-315 pendingReadIndexMessages)
+    ro_pend_ctx: jnp.ndarray  # i32[R]
+    ro_pend_from: jnp.ndarray # i32[R]
+    ro_pend_count: jnp.ndarray  # i32
+    # ReadStates surfaced to the local application (raft.go:249)
+    rs_ctx: jnp.ndarray       # i32[R]
+    rs_index: jnp.ndarray     # i32[R]
+    rs_count: jnp.ndarray     # i32
+
+
+def init_node(
+    spec: Spec,
+    nid: int | jnp.ndarray,
+    voters: jnp.ndarray,
+    learners: jnp.ndarray | None = None,
+    seed: int | jnp.ndarray = 0,
+) -> NodeState:
+    """A fresh follower at term 0 with the given applied config.
+
+    Equivalent to newRaft on a MemoryStorage whose ConfState is already set
+    (the way raft_test.go's newTestRaft boots; raft/raft.go:318-370) — the
+    log is empty, commit/applied = 0.
+    """
+    M, L, W, R = spec.M, spec.L, spec.W, spec.R
+    if learners is None:
+        learners = jnp.zeros((M,), jnp.bool_)
+    fM = jnp.zeros((M,), jnp.bool_)
+    z = jnp.int32(0)
+    nid = jnp.asarray(nid, jnp.int32)
+    key = jax.random.fold_in(jax.random.PRNGKey(0), jnp.asarray(seed, jnp.int32))
+    key = jax.random.fold_in(key, nid)
+    return NodeState(
+        nid=nid,
+        term=z, vote=jnp.int32(NONE_ID), commit=z,
+        lead=jnp.int32(NONE_ID), role=jnp.int32(ROLE_FOLLOWER),
+        log_term=jnp.zeros((L,), jnp.int32),
+        log_data=jnp.zeros((L,), jnp.int32),
+        log_type=jnp.zeros((L,), jnp.int32),
+        last_index=z, applied=z, applied_hash=z,
+        snap_index=z, snap_term=z, snap_hash=z,
+        snap_voters=voters, snap_voters_out=fM,
+        snap_learners=learners, snap_learners_next=fM,
+        snap_auto_leave=jnp.bool_(False),
+        election_elapsed=z, heartbeat_elapsed=z,
+        randomized_timeout=jnp.int32(INT32_SAFE_TIMEOUT),
+        rng_key=key,
+        match=jnp.zeros((M,), jnp.int32),
+        next_idx=jnp.ones((M,), jnp.int32),
+        pr_state=jnp.full((M,), PR_PROBE, jnp.int32),
+        probe_sent=fM,
+        pending_snapshot=jnp.zeros((M,), jnp.int32),
+        recent_active=fM,
+        infl_ends=jnp.zeros((M, W), jnp.int32),
+        infl_start=jnp.zeros((M,), jnp.int32),
+        infl_count=jnp.zeros((M,), jnp.int32),
+        votes_responded=fM, votes_granted=fM,
+        voters=voters, voters_out=fM,
+        learners=learners, learners_next=fM,
+        auto_leave=jnp.bool_(False),
+        pending_conf_index=z, uncommitted_size=z,
+        lead_transferee=jnp.int32(NONE_ID),
+        ro_ctx=jnp.zeros((R,), jnp.int32),
+        ro_index=jnp.zeros((R,), jnp.int32),
+        ro_from=jnp.full((R,), NONE_ID, jnp.int32),
+        ro_acks=jnp.zeros((R, M), jnp.bool_),
+        ro_count=z,
+        ro_pend_ctx=jnp.zeros((R,), jnp.int32),
+        ro_pend_from=jnp.full((R,), NONE_ID, jnp.int32),
+        ro_pend_count=z,
+        rs_ctx=jnp.zeros((R,), jnp.int32),
+        rs_index=jnp.zeros((R,), jnp.int32),
+        rs_count=z,
+    )
+
+
+# placeholder large timeout until the first reset_randomized_timeout; real
+# value is drawn in [election_tick, 2*election_tick) on become_follower.
+INT32_SAFE_TIMEOUT = 1 << 20
+
+
+def is_joint(n: NodeState) -> jnp.ndarray:
+    return n.voters_out.any()
+
+
+def is_learner_self(n: NodeState) -> jnp.ndarray:
+    self_hot = jnp.arange(n.voters.shape[0], dtype=jnp.int32) == n.nid
+    return (self_hot & n.learners).any()
+
+
+def in_config_self(n: NodeState) -> jnp.ndarray:
+    """Whether this node has a Progress entry, i.e. is voter/outgoing/learner."""
+    self_hot = jnp.arange(n.voters.shape[0], dtype=jnp.int32) == n.nid
+    return (self_hot & (n.voters | n.voters_out | n.learners)).any()

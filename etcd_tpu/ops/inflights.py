@@ -1,0 +1,68 @@
+"""Inflights: the per-follower sliding window of in-flight MsgApps.
+
+Re-expression of the reference's ring buffer (raft/tracker/inflights.go:22-132)
+as fixed [M, W] tensors on the leader: `ends[d]` holds the last-entry indexes
+of in-flight appends to destination d in a ring window [start, start+count).
+Because appends are sent in increasing index order the ring is sorted, so
+FreeLE is a masked prefix count.
+
+All ops are vectorized over the destination axis and gated by a mask.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from etcd_tpu.models.state import NodeState
+from etcd_tpu.types import Spec
+
+
+def _valid(spec: Spec, n: NodeState) -> jnp.ndarray:
+    """[M, W] bool: which ring positions hold live ends."""
+    w = jnp.arange(spec.W, dtype=jnp.int32)[None, :]
+    rel = (w - n.infl_start[:, None]) % spec.W
+    return rel < n.infl_count[:, None]
+
+
+def add(spec: Spec, n: NodeState, mask: jnp.ndarray, end: jnp.ndarray) -> NodeState:
+    """Inflights.Add (inflights.go:56-75) for destinations in `mask`."""
+    pos = (n.infl_start + n.infl_count) % spec.W
+    w = jnp.arange(spec.W, dtype=jnp.int32)[None, :]
+    do = mask & (n.infl_count < spec.W)
+    sel = do[:, None] & (w == pos[:, None])
+    return n.replace(
+        infl_ends=jnp.where(sel, end[:, None] if end.ndim else end, n.infl_ends),
+        infl_count=n.infl_count + do.astype(jnp.int32),
+    )
+
+
+def free_le(spec: Spec, n: NodeState, mask: jnp.ndarray, idx: jnp.ndarray) -> NodeState:
+    """Inflights.FreeLE (inflights.go:95-122): pop the (sorted) prefix <= idx."""
+    freed = (_valid(spec, n) & (n.infl_ends <= idx)).sum(axis=-1).astype(jnp.int32)
+    freed = jnp.where(mask, freed, 0)
+    return n.replace(
+        infl_start=(n.infl_start + freed) % spec.W,
+        infl_count=n.infl_count - freed,
+    )
+
+
+def free_first_one(spec: Spec, n: NodeState, mask: jnp.ndarray) -> NodeState:
+    """Inflights.FreeFirstOne (inflights.go:126-132)."""
+    do = mask & (n.infl_count > 0)
+    return n.replace(
+        infl_start=jnp.where(do, (n.infl_start + 1) % spec.W, n.infl_start),
+        infl_count=n.infl_count - do.astype(jnp.int32),
+    )
+
+
+def reset(n: NodeState, mask: jnp.ndarray) -> NodeState:
+    """Inflights.reset (via Progress.ResetState, tracker/progress.go:84-90)."""
+    z = jnp.zeros_like(n.infl_count)
+    return n.replace(
+        infl_start=jnp.where(mask, z, n.infl_start),
+        infl_count=jnp.where(mask, z, n.infl_count),
+    )
+
+
+def full(max_inflight: int, n: NodeState) -> jnp.ndarray:
+    """Inflights.Full (inflights.go:78-81): [M] bool."""
+    return n.infl_count >= max_inflight

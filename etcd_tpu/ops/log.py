@@ -1,0 +1,200 @@
+"""Log-ring kernels: term lookup, conflict probes, append, commit cursors.
+
+TPU-native re-expression of the reference's ``raftLog`` (raft/log.go) over a
+fixed-capacity ring: entry index ``i`` lives at slot ``(i-1) % L`` and the
+valid window is ``(snap_index, last_index]``. The stable/unstable split of
+raft/log_unstable.go disappears (pure-device log); ``ErrCompacted`` /
+``ErrUnavailable`` become ``ok`` flags.
+
+All functions take/return a single NodeState (vmapped by callers).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from etcd_tpu.models.state import NodeState
+from etcd_tpu.types import Spec
+
+
+def slot(spec: Spec, idx: jnp.ndarray) -> jnp.ndarray:
+    return (idx - 1) % spec.L
+
+
+def first_index(n: NodeState) -> jnp.ndarray:
+    return n.snap_index + 1
+
+
+def term_at(spec: Spec, n: NodeState, idx: jnp.ndarray):
+    """(term, ok). Mirrors raftLog.term (log.go:265-285): ok is False outside
+    [snap_index, last_index] (the reference returns (0, nil) below the dummy
+    index and errors inside the compacted range; callers here only need the
+    combined "can't tell" signal)."""
+    t = n.log_term[slot(spec, idx)]
+    t = jnp.where(idx == n.snap_index, n.snap_term, t)
+    ok = (idx >= n.snap_index) & (idx <= n.last_index)
+    return jnp.where(ok, t, 0).astype(jnp.int32), ok
+
+
+def match_term(spec: Spec, n: NodeState, idx: jnp.ndarray, term: jnp.ndarray):
+    t, ok = term_at(spec, n, idx)
+    return ok & (t == term)
+
+
+def last_term(spec: Spec, n: NodeState) -> jnp.ndarray:
+    t, _ = term_at(spec, n, n.last_index)
+    return t
+
+
+def is_up_to_date(spec: Spec, n: NodeState, lasti, term) -> jnp.ndarray:
+    """raftLog.isUpToDate (log.go:313-315)."""
+    lt = last_term(spec, n)
+    return (term > lt) | ((term == lt) & (lasti >= n.last_index))
+
+
+def commit_to(n: NodeState, tocommit: jnp.ndarray) -> NodeState:
+    """raftLog.commitTo (log.go:233-241); never decreases, clamped to
+    last_index (the reference panics past lastIndex — heartbeats only carry
+    min(match, commit) so the clamp is defensive)."""
+    c = jnp.clip(tocommit, n.commit, n.last_index)
+    return n.replace(commit=jnp.maximum(n.commit, c))
+
+
+def find_conflict_by_term(spec: Spec, n: NodeState, index, term) -> jnp.ndarray:
+    """Largest i <= index with term(i) <= term (raft/log.go:147-168), the
+    log-divergence probe optimization. Out-of-range index is returned as-is.
+
+    Masked-max over the ring instead of the reference's walk-down loop; the
+    candidates below snap_index all have effective term 0 <= term, so
+    min(index, snap_index - 1) is always achievable, exactly like the
+    reference's term()==(0, nil) floor."""
+    idxs = jnp.arange(spec.L, dtype=jnp.int32)
+    # entry index stored in each slot, for the current window
+    ent_idx = n.last_index - ((slot(spec, n.last_index) - idxs) % spec.L)
+    in_win = (ent_idx > n.snap_index) & (ent_idx <= jnp.minimum(index, n.last_index))
+    cand = jnp.where(in_win & (n.log_term <= term), ent_idx, -1)
+    best = cand.max()
+    best = jnp.maximum(
+        best,
+        jnp.where((n.snap_term <= term) & (n.snap_index <= index), n.snap_index, -1),
+    )
+    best = jnp.maximum(best, jnp.minimum(index, n.snap_index - 1))
+    return jnp.where(index > n.last_index, index, best).astype(jnp.int32)
+
+
+def append_span(
+    spec: Spec,
+    n: NodeState,
+    prev_index: jnp.ndarray,
+    ent_len: jnp.ndarray,
+    ent_term: jnp.ndarray,
+    ent_data: jnp.ndarray,
+    ent_type: jnp.ndarray,
+    enable: jnp.ndarray,
+) -> NodeState:
+    """Unconditionally truncate-and-append entries (prev_index, prev_index+len]
+    when `enable`; callers implement the maybeAppend/findConflict policy.
+    After the write last_index = prev_index + ent_len (truncation semantics of
+    unstable.truncateAndAppend, log_unstable.go:121)."""
+    new_last = prev_index + ent_len
+    for e in range(spec.E):
+        idx = prev_index + 1 + e
+        write = enable & (e < ent_len)
+        s = slot(spec, idx)
+        n = n.replace(
+            log_term=n.log_term.at[s].set(
+                jnp.where(write, ent_term[e], n.log_term[s])
+            ),
+            log_data=n.log_data.at[s].set(
+                jnp.where(write, ent_data[e], n.log_data[s])
+            ),
+            log_type=n.log_type.at[s].set(
+                jnp.where(write, ent_type[e], n.log_type[s])
+            ),
+        )
+    return n.replace(last_index=jnp.where(enable, new_last, n.last_index))
+
+
+def maybe_append(
+    spec: Spec,
+    n: NodeState,
+    m_index: jnp.ndarray,
+    m_log_term: jnp.ndarray,
+    m_commit: jnp.ndarray,
+    ent_len: jnp.ndarray,
+    ent_term: jnp.ndarray,
+    ent_data: jnp.ndarray,
+    ent_type: jnp.ndarray,
+    enable: jnp.ndarray,
+):
+    """raftLog.maybeAppend (log.go:88-104). Returns (state, last_new_i, ok).
+
+    findConflict (log.go:127-138): first offered entry whose term mismatches
+    the local log (an index past last_index always mismatches). Entries before
+    the conflict are already present; entries from the conflict on are
+    truncate-appended. Conflicts at/below commit panic in the reference; here
+    they cannot happen for well-formed inputs and are simply overwritten.
+    """
+    ok = match_term(spec, n, m_index, m_log_term)
+    do = enable & ok
+    last_new_i = m_index + ent_len
+
+    # conflict scan over the (small, static) offered span
+    offs = jnp.arange(spec.E, dtype=jnp.int32)
+    idxs = m_index + 1 + offs
+    valid = offs < ent_len
+    t_here, ok_here = term_at(spec, n, idxs)
+    matches = valid & ok_here & (t_here == ent_term)
+    mismatch = valid & ~matches
+    any_conflict = mismatch.any()
+    ci_off = jnp.where(any_conflict, jnp.argmax(mismatch), 0).astype(jnp.int32)
+
+    # append entries [ci, last_new_i]; shift the offered span left by ci_off
+    # so append_span sees prev_index = m_index + ci_off.
+    def shift(a):
+        return jnp.roll(a, -ci_off, axis=0)
+
+    n = append_span(
+        spec,
+        n,
+        m_index + ci_off,
+        ent_len - ci_off,
+        shift(ent_term),
+        shift(ent_data),
+        shift(ent_type),
+        do & any_conflict,
+    )
+    # gated commitTo(min(m_commit, last_new_i))
+    c = jnp.clip(jnp.minimum(m_commit, last_new_i), n.commit, n.last_index)
+    n = n.replace(commit=jnp.where(do, jnp.maximum(n.commit, c), n.commit))
+    return n, jnp.where(do, last_new_i, 0).astype(jnp.int32), ok
+
+
+def entries_from(spec: Spec, n: NodeState, lo: jnp.ndarray):
+    """Up to E entries starting at index `lo` (raftLog.entries / slice used by
+    maybeSendAppend, raft.go:441). Returns (len, term[E], data[E], type[E]).
+    Caller guarantees lo > snap_index (else the snapshot path is taken)."""
+    offs = jnp.arange(spec.E, dtype=jnp.int32)
+    idxs = lo + offs
+    valid = (idxs >= first_index(n)) & (idxs <= n.last_index)
+    s = slot(spec, idxs)
+    ln = jnp.clip(n.last_index - lo + 1, 0, spec.E).astype(jnp.int32)
+    zero = jnp.zeros((spec.E,), jnp.int32)
+    return (
+        ln,
+        jnp.where(valid, n.log_term[s], zero),
+        jnp.where(valid, n.log_data[s], zero),
+        jnp.where(valid, n.log_type[s], zero),
+    )
+
+
+def count_pending_conf(spec: Spec, n: NodeState, lo: jnp.ndarray, hi: jnp.ndarray):
+    """#conf-change entries with index in (lo, hi] — numOfPendingConf over
+    the (applied, committed] window used by hup (raft.go:760-777)."""
+    idxs = jnp.arange(spec.L, dtype=jnp.int32)
+    ent_idx = n.last_index - ((slot(spec, n.last_index) - idxs) % spec.L)
+    in_win = (ent_idx > lo) & (ent_idx <= hi) & (ent_idx > n.snap_index) & (
+        ent_idx <= n.last_index
+    )
+    from etcd_tpu.types import ENTRY_CONF_CHANGE
+
+    return (in_win & (n.log_type == ENTRY_CONF_CHANGE)).sum().astype(jnp.int32)

@@ -1,0 +1,70 @@
+"""Per-node outbox: K message slots per destination with overflow-drop.
+
+The reference accumulates outbound messages in ``r.msgs`` (raft/raft.go:264,
+appended by send() at raft.go:386-419) and the transport may drop messages
+("Send MUST NOT block / drop is OK", server/etcdserver/raft.go:107-110;
+rafttest/network.go:106-108). Here the outbox is a dense ``[M, K]`` plane of
+Msg slots plus a per-destination fill counter; emitting past K drops the
+message, which is legal by the same contract.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from etcd_tpu.types import Msg, NONE_ID, Spec, empty_msg
+
+
+class Outbox(struct.PyTreeNode):
+    msgs: Msg              # leaves [M, K, ...]
+    counts: jnp.ndarray    # i32[M]
+
+
+def empty_outbox(spec: Spec) -> Outbox:
+    m = empty_msg(spec)
+    msgs = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (spec.M, spec.K) + x.shape), m
+    )
+    return Outbox(msgs=msgs, counts=jnp.zeros((spec.M,), jnp.int32))
+
+
+def make_msg(spec: Spec, **kw) -> Msg:
+    """A scalar Msg with given fields, rest defaulted."""
+    base = empty_msg(spec)
+    conv = {}
+    for k, v in kw.items():
+        ref = getattr(base, k)
+        conv[k] = jnp.asarray(v, ref.dtype)
+    return base.replace(**conv)
+
+
+def bcast(spec: Spec, m: Msg) -> Msg:
+    """Broadcast a scalar Msg to per-destination leaves [M, ...]."""
+    return jax.tree.map(lambda x: jnp.broadcast_to(x, (spec.M,) + x.shape), m)
+
+
+def emit(spec: Spec, ob: Outbox, to_mask: jnp.ndarray, m: Msg) -> Outbox:
+    """Write per-destination message m (leaves [M, ...]) into the next free
+    slot for every destination in `to_mask`; silently drop on overflow."""
+    slot_idx = ob.counts                       # [M]
+    can = to_mask & (slot_idx < spec.K)        # [M]
+    sel = can[:, None] & (
+        jnp.arange(spec.K, dtype=jnp.int32)[None, :] == slot_idx[:, None]
+    )  # [M, K]
+
+    def upd(old, new):
+        extra = old.ndim - 2
+        s = sel.reshape(sel.shape + (1,) * extra)
+        return jnp.where(s, new[:, None], old)
+
+    msgs = jax.tree.map(upd, ob.msgs, m)
+    return Outbox(msgs=msgs, counts=ob.counts + can.astype(jnp.int32))
+
+
+def emit_one(
+    spec: Spec, ob: Outbox, to: jnp.ndarray, m: Msg, enable: jnp.ndarray
+) -> Outbox:
+    """Emit a scalar Msg to a single destination id (gated by `enable`)."""
+    to_mask = (jnp.arange(spec.M, dtype=jnp.int32) == to) & enable
+    return emit(spec, ob, to_mask, bcast(spec, m))

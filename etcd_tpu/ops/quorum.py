@@ -1,0 +1,83 @@
+"""Quorum kernels: commit-index and vote tallies over majority & joint configs.
+
+TPU-native re-expression of the reference's ``raft/quorum`` package:
+  * ``MajorityConfig.CommittedIndex`` (quorum/majority.go:126-172): sort the
+    match indexes of the voters, take ``srt[n-(n/2+1)]``. Here the config is a
+    bool[M] mask and the sort is a fixed-size ``jnp.sort`` — unacked voters
+    report 0, non-voters sort to +inf so the quantile lands on voters only.
+  * ``MajorityConfig.VoteResult`` (quorum/majority.go:178-210): won iff a
+    quorum of yes, lost iff yes can no longer reach quorum, else pending.
+  * ``JointConfig`` variants (quorum/joint.go:49-75): min / combine of the
+    two majority halves, an empty half behaving like the other half.
+
+All functions are written for a single group (1-D [M] inputs) and batched by
+``jax.vmap``; they are the #1 hot kernel per SURVEY.md §3 hot-loop ranking.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from etcd_tpu.types import INT32_MAX, VOTE_LOST, VOTE_PENDING, VOTE_WON
+
+
+def committed_index(voters: jnp.ndarray, acked: jnp.ndarray) -> jnp.ndarray:
+    """Largest index acked by a quorum of `voters`.
+
+    voters: bool[M] membership mask; acked: i32[M] per-member acked index
+    (0 for voters that have not reported). Empty config -> INT32_MAX, which
+    makes joint quorums behave like the populated half (majority.go:128-132).
+    """
+    n = voters.sum().astype(jnp.int32)
+    vals = jnp.where(voters, acked, INT32_MAX)
+    srt = jnp.sort(vals)  # ascending: voters occupy positions [0, n)
+    pos = jnp.maximum(n - (n // 2 + 1), 0)
+    return jnp.where(n == 0, INT32_MAX, srt[pos]).astype(jnp.int32)
+
+
+def joint_committed_index(
+    voters_incoming: jnp.ndarray,
+    voters_outgoing: jnp.ndarray,
+    acked: jnp.ndarray,
+) -> jnp.ndarray:
+    """min of both halves' committed indexes (quorum/joint.go:70-75)."""
+    return jnp.minimum(
+        committed_index(voters_incoming, acked),
+        committed_index(voters_outgoing, acked),
+    )
+
+
+def vote_result(
+    voters: jnp.ndarray, responded: jnp.ndarray, granted: jnp.ndarray
+) -> jnp.ndarray:
+    """VOTE_WON / VOTE_LOST / VOTE_PENDING for one majority config.
+
+    voters/responded/granted: bool[M]. Empty config wins by convention
+    (majority.go:179-184).
+    """
+    n = voters.sum().astype(jnp.int32)
+    q = n // 2 + 1
+    yes = (voters & responded & granted).sum().astype(jnp.int32)
+    no = (voters & responded & ~granted).sum().astype(jnp.int32)
+    missing = n - yes - no
+    won = (yes >= q) | (n == 0)
+    pending = ~won & (yes + missing >= q)
+    return jnp.where(won, VOTE_WON, jnp.where(pending, VOTE_PENDING, VOTE_LOST)).astype(
+        jnp.int32
+    )
+
+
+def joint_vote_result(
+    voters_incoming: jnp.ndarray,
+    voters_outgoing: jnp.ndarray,
+    responded: jnp.ndarray,
+    granted: jnp.ndarray,
+) -> jnp.ndarray:
+    """Combine both halves (quorum/joint.go:49-68): if either half lost the
+    joint vote is lost; won only if both halves won; else pending."""
+    r1 = vote_result(voters_incoming, responded, granted)
+    r2 = vote_result(voters_outgoing, responded, granted)
+    lost = (r1 == VOTE_LOST) | (r2 == VOTE_LOST)
+    won = (r1 == VOTE_WON) & (r2 == VOTE_WON)
+    return jnp.where(lost, VOTE_LOST, jnp.where(won, VOTE_WON, VOTE_PENDING)).astype(
+        jnp.int32
+    )

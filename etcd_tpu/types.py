@@ -1,0 +1,165 @@
+"""Core wire/state types for the TPU-native batched Raft engine.
+
+These mirror the *contracts* of the reference's ``raft/raftpb/raft.proto``
+(message types at raft.proto:46-66, Entry/HardState at raft.proto:69-113)
+but are laid out as dense, fixed-width integer fields so that a message is
+a struct-of-arrays slot in a ``[clusters, members, members, K]`` tensor
+rather than a protobuf on a wire.
+
+Conventions (deliberately different from the Go reference where that makes
+the tensor program better):
+  * member ids are 0-based (0..M-1); "None" (no leader / no vote) is -1,
+    not 0, so ids can index arrays directly.
+  * terms/indexes are int32 (simulation-scale; the reference uses uint64).
+  * member *sets* (ConfState voter/learner sets, raft.proto:115-130) are
+    packed int32 bitmasks in messages and bool[M] masks in node state.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from flax import struct
+
+# ---------------------------------------------------------------------------
+# Scalar constants
+# ---------------------------------------------------------------------------
+
+NONE_ID = -1  # reference: None uint64 = 0 (raft/raft.go:35); we use -1
+INT32_MAX = jnp.iinfo(jnp.int32).max  # stands in for math.MaxUint64 sentinels
+
+# Roles (reference StateType, raft/raft.go:39-45)
+ROLE_FOLLOWER = 0
+ROLE_PRE_CANDIDATE = 1
+ROLE_CANDIDATE = 2
+ROLE_LEADER = 3
+
+# Message types (reference raft/raftpb/raft.proto:46-66). Type 0 is reserved
+# for "empty slot" so a zeroed message tensor means "no message".
+MSG_NONE = 0
+MSG_APP = 1
+MSG_APP_RESP = 2
+MSG_VOTE = 3
+MSG_VOTE_RESP = 4
+MSG_SNAP = 5
+MSG_HEARTBEAT = 6
+MSG_HEARTBEAT_RESP = 7
+MSG_PRE_VOTE = 8
+MSG_PRE_VOTE_RESP = 9
+MSG_TRANSFER_LEADER = 10
+MSG_TIMEOUT_NOW = 11
+MSG_READ_INDEX = 12
+MSG_READ_INDEX_RESP = 13
+MSG_PROP = 14
+MSG_UNREACHABLE = 15
+MSG_SNAP_STATUS = 16
+NUM_MSG_TYPES = 17
+
+# Entry types (raft.proto:69-74)
+ENTRY_NORMAL = 0
+ENTRY_CONF_CHANGE = 1  # we only model the V2-equivalent, encoded in data
+
+# Vote results (reference quorum/quorum.go:50-58)
+VOTE_PENDING = 0
+VOTE_WON = 1
+VOTE_LOST = 2
+
+# Progress states (reference tracker/state.go:20-34)
+PR_PROBE = 0
+PR_REPLICATE = 1
+PR_SNAPSHOT = 2
+
+# Campaign types (raft/raft.go:62-71); carried in Msg.context for vote
+# requests so transfer-campaigns can force past the lease check.
+CAMPAIGN_NONE = 0
+CAMPAIGN_TRANSFER = 1
+
+# Conf-change ops, encoded into a conf-change entry's data word.
+# (reference raft.proto:145-153 ConfChangeType)
+CC_ADD_NODE = 0
+CC_REMOVE_NODE = 1
+CC_UPDATE_NODE = 2
+CC_ADD_LEARNER = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class Spec:
+    """Static shape/config parameters shared by every kernel.
+
+    The dynamic per-run knobs (tick counts etc.) live in
+    :class:`etcd_tpu.utils.config.RaftConfig`; Spec is only what determines
+    array shapes and trace-time structure.
+    """
+
+    M: int = 5        # members per cluster
+    L: int = 64       # log ring capacity (entries held on device per node)
+    E: int = 4        # max entries carried by one MsgApp
+    K: int = 4        # message slots per (sender, receiver) pair per round
+    W: int = 4        # inflight window ring size (max_inflight)
+    R: int = 4        # read-only request queue depth
+    A: int = 8        # max committed entries applied per node per round
+
+
+# ---------------------------------------------------------------------------
+# Message struct-of-arrays
+# ---------------------------------------------------------------------------
+
+
+class Msg(struct.PyTreeNode):
+    """One message slot (all leaves scalar; batched via vmap/stacking).
+
+    Field reuse per type (mirrors pb.Message usage, raft.proto:75-96):
+      MSG_APP:       index=prevLogIndex, log_term=prevLogTerm, commit,
+                     ent_len/ent_term/ent_data/ent_type = entries
+      MSG_APP_RESP:  index=acked/rejected idx, reject, reject_hint, log_term=hint term
+      MSG_VOTE/PRE:  index=lastIndex, log_term=lastTerm, context=campaign type
+      MSG_SNAP:      index=snap index, log_term=snap term, commit=applied hash,
+                     c_voters/c_voters_out/c_learners/c_learners_next = packed
+                     ConfState masks, reject=auto_leave flag
+      MSG_HEARTBEAT: commit=min(match, committed), context=readindex ctx
+      MSG_READ_INDEX(_RESP): context=request ctx id, index=read index
+      MSG_PROP:      ent_* carries proposed entries
+    """
+
+    type: jnp.ndarray      # i32
+    term: jnp.ndarray      # i32 (0 == local/termless message, like reference)
+    frm: jnp.ndarray       # i32 sender id
+    index: jnp.ndarray     # i32
+    log_term: jnp.ndarray  # i32
+    commit: jnp.ndarray    # i32
+    reject: jnp.ndarray    # bool
+    reject_hint: jnp.ndarray  # i32
+    context: jnp.ndarray   # i32
+    ent_len: jnp.ndarray   # i32
+    ent_term: jnp.ndarray  # i32[E]
+    ent_data: jnp.ndarray  # i32[E]
+    ent_type: jnp.ndarray  # i32[E]
+    c_voters: jnp.ndarray        # i32 packed mask (MsgSnap)
+    c_voters_out: jnp.ndarray    # i32 packed mask (MsgSnap)
+    c_learners: jnp.ndarray      # i32 packed mask (MsgSnap)
+    c_learners_next: jnp.ndarray # i32 packed mask (MsgSnap)
+
+
+def empty_msg(spec: Spec) -> Msg:
+    z = jnp.int32(0)
+    return Msg(
+        type=z, term=z, frm=jnp.int32(NONE_ID), index=z, log_term=z,
+        commit=z, reject=jnp.bool_(False), reject_hint=z, context=z,
+        ent_len=z,
+        ent_term=jnp.zeros((spec.E,), jnp.int32),
+        ent_data=jnp.zeros((spec.E,), jnp.int32),
+        ent_type=jnp.zeros((spec.E,), jnp.int32),
+        c_voters=z, c_voters_out=z, c_learners=z, c_learners_next=z,
+    )
+
+
+def pack_mask(mask: jnp.ndarray) -> jnp.ndarray:
+    """bool[M] -> i32 bitmask."""
+    m = mask.shape[-1]
+    bits = (mask.astype(jnp.int32) << jnp.arange(m, dtype=jnp.int32))
+    return bits.sum(axis=-1).astype(jnp.int32)
+
+
+def unpack_mask(packed: jnp.ndarray, m: int) -> jnp.ndarray:
+    """i32 bitmask -> bool[M]."""
+    return ((packed[..., None] >> jnp.arange(m, dtype=jnp.int32)) & 1).astype(jnp.bool_)

@@ -1,0 +1,42 @@
+"""Run-level Raft knobs — parity with the reference's ``raft.Config``
+(raft/raft.go:116-199), minus the Go-runtime-specific fields (Storage/Logger)
+and with byte limits re-expressed as entry counts (payloads are fixed-width
+words on device).
+
+These are *static* (trace-time) parameters: they select code paths and
+bounds inside the jitted step, so changing them recompiles.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class RaftConfig:
+    # tick counts (raft.Config.ElectionTick/HeartbeatTick)
+    election_tick: int = 10
+    heartbeat_tick: int = 1
+    # flow control: raft.Config.MaxInflightMsgs; must be <= Spec.W
+    max_inflight: int = 4
+    # raft.Config.MaxUncommittedEntriesSize, in entries (0 disables like ref)
+    max_uncommitted: int = 0
+    # raft.Config.PreVote (thesis §9.6)
+    pre_vote: bool = False
+    # raft.Config.CheckQuorum (leader steps down without quorum contact)
+    check_quorum: bool = False
+    # raft.Config.ReadOnlyOption: False=ReadOnlySafe, True=ReadOnlyLeaseBased
+    read_only_lease_based: bool = False
+    # raft.Config.DisableProposalForwarding
+    disable_proposal_forwarding: bool = False
+
+    def __post_init__(self):
+        if self.heartbeat_tick <= 0:
+            raise ValueError("heartbeat tick must be greater than 0")
+        if self.election_tick <= self.heartbeat_tick:
+            raise ValueError("election tick must be greater than heartbeat tick")
+        if self.read_only_lease_based and not self.check_quorum:
+            raise ValueError("CheckQuorum must be enabled for lease-based reads")
+
+    @property
+    def max_uncommitted_entries(self) -> int:
+        return self.max_uncommitted if self.max_uncommitted > 0 else (1 << 30)

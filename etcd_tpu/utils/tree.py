@@ -1,0 +1,10 @@
+"""Small pytree utilities."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_where(pred, on_true, on_false):
+    """Leafwise jnp.where with a scalar (or broadcastable) predicate."""
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), on_true, on_false)

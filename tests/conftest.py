@@ -1,0 +1,10 @@
+import os
+
+# Tests always run on a virtual 8-device CPU mesh so sharding paths are
+# exercised without TPU hardware (and unit tests stay fast/deterministic).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

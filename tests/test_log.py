@@ -1,0 +1,219 @@
+"""Log-ring kernels vs a Python oracle of raftLog semantics.
+
+Covers the behaviors of raft/log_test.go (findConflict, maybeAppend,
+term/commitTo, isUpToDate) and the findConflictByTerm probe optimization
+(raft/log.go:147-168), over randomized ring states including compacted
+prefixes. All queries for a test are stacked and evaluated in ONE jitted
+vmap call (host dispatch is the bottleneck in CI).
+"""
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from etcd_tpu.models.state import init_node
+from etcd_tpu.ops import log as logops
+from etcd_tpu.types import Spec
+
+SPEC = Spec(M=3, L=16, E=4)
+
+
+def mk_node(terms, snap_index=0, snap_term=0, commit=0):
+    n = init_node(SPEC, 0, jnp.ones((SPEC.M,), jnp.bool_))
+    lt = np.zeros((SPEC.L,), np.int32)
+    ld = np.zeros((SPEC.L,), np.int32)
+    for i, t in enumerate(terms):
+        idx = snap_index + 1 + i
+        lt[(idx - 1) % SPEC.L] = t
+        ld[(idx - 1) % SPEC.L] = idx * 100 + t
+    return n.replace(
+        log_term=jnp.asarray(lt),
+        log_data=jnp.asarray(ld),
+        last_index=jnp.int32(snap_index + len(terms)),
+        snap_index=jnp.int32(snap_index),
+        snap_term=jnp.int32(snap_term),
+        commit=jnp.int32(commit),
+        applied=jnp.int32(snap_index),
+    )
+
+
+def stack(nodes):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *nodes)
+
+
+class OracleLog:
+    def __init__(self, terms, snap_index=0, snap_term=0, commit=0):
+        self.terms = dict((snap_index + 1 + i, t) for i, t in enumerate(terms))
+        self.snap_index, self.snap_term = snap_index, snap_term
+        self.last = snap_index + len(terms)
+        self.commit = commit
+
+    def term(self, i):
+        if i == self.snap_index:
+            return self.snap_term, True
+        if i in self.terms:
+            return self.terms[i], True
+        return 0, False
+
+    def match_term(self, i, t):
+        got, ok = self.term(i)
+        return ok and got == t
+
+    def find_conflict_by_term(self, index, term):
+        if index > self.last:
+            return index
+        i = index
+        while True:
+            t, ok = self.term(i)
+            if not ok and i < self.snap_index:
+                t, ok = 0, True  # below dummy: reference returns (0, nil)
+            if (ok and t <= term) or not ok:
+                return i
+            i -= 1
+
+    def maybe_append(self, index, log_term, committed, ents):
+        if not self.match_term(index, log_term):
+            return 0, False
+        lastnewi = index + len(ents)
+        ci = 0
+        for off, t in enumerate(ents):
+            if not self.match_term(index + 1 + off, t):
+                ci = index + 1 + off
+                break
+        if ci != 0:
+            for off in range(ci - index - 1, len(ents)):
+                self.terms[index + 1 + off] = ents[off]
+            self.last = lastnewi
+            for i in list(self.terms):
+                if i > self.last:
+                    del self.terms[i]
+        self.commit = max(self.commit, min(committed, lastnewi))
+        return lastnewi, True
+
+
+def rand_log(rng):
+    snap_index = rng.randrange(0, 5)
+    snap_term = rng.randrange(0, 3)
+    nlen = rng.randrange(0, 8)
+    terms = []
+    t = max(snap_term, 1)
+    for _ in range(nlen):
+        t += rng.randrange(0, 2)
+        terms.append(t)
+    commit = snap_index + rng.randrange(0, nlen + 1)
+    return terms, snap_index, snap_term, commit
+
+
+def host_window(n2, i):
+    """Read entry terms of node state row i from numpy arrays."""
+    last = int(n2.last_index[i])
+    snap = int(n2.snap_index[i])
+    lt = np.asarray(n2.log_term[i])
+    return {j: int(lt[(j - 1) % SPEC.L]) for j in range(snap + 1, last + 1)}
+
+
+def test_term_at_and_conflict_by_term():
+    rng = random.Random(10)
+    nodes, idxs, cterms, oracles = [], [], [], []
+    for _ in range(40):
+        terms, si, st, cm = rand_log(rng)
+        o = OracleLog(terms, si, st, cm)
+        n = mk_node(terms, si, st, cm)
+        for i in range(0, si + len(terms) + 3):
+            for t in range(0, 5):
+                nodes.append(n)
+                idxs.append(i)
+                cterms.append(t)
+                oracles.append(o)
+    ns = stack(nodes)
+    idxs_a = jnp.asarray(idxs, jnp.int32)
+    ct_a = jnp.asarray(cterms, jnp.int32)
+
+    t_got, ok_got = jax.jit(jax.vmap(lambda n, i: logops.term_at(SPEC, n, i)))(
+        ns, idxs_a
+    )
+    fc_got = jax.jit(
+        jax.vmap(lambda n, i, t: logops.find_conflict_by_term(SPEC, n, i, t))
+    )(ns, idxs_a, ct_a)
+    t_got, ok_got, fc_got = map(np.asarray, (t_got, ok_got, fc_got))
+
+    for k, o in enumerate(oracles):
+        ot, ook = o.term(idxs[k])
+        assert bool(ok_got[k]) == ook, (k, idxs[k])
+        if ook:
+            assert t_got[k] == ot
+        want = o.find_conflict_by_term(idxs[k], cterms[k])
+        assert fc_got[k] == want, (k, idxs[k], cterms[k], fc_got[k], want)
+
+
+def test_is_up_to_date():
+    n = mk_node([1, 1, 2])
+    cases = [(3, 2, True), (4, 2, True), (1, 3, True), (2, 2, False), (9, 1, False)]
+    got = np.asarray(
+        jax.vmap(lambda i, t: logops.is_up_to_date(SPEC, n, i, t))(
+            jnp.asarray([c[0] for c in cases], jnp.int32),
+            jnp.asarray([c[1] for c in cases], jnp.int32),
+        )
+    )
+    assert got.tolist() == [c[2] for c in cases]
+
+
+def test_maybe_append_random():
+    rng = random.Random(12)
+    nodes, args, oracles = [], [], []
+    for _ in range(200):
+        terms, si, st, cm = rand_log(rng)
+        o = OracleLog(terms, si, st, cm)
+        base = si + rng.randrange(0, len(terms) + 2)
+        bt, _ = o.term(base)
+        if rng.random() < 0.3:
+            bt = rng.randrange(0, 4)
+        elen = rng.randrange(0, SPEC.E + 1)
+        ents, t = [], max(bt, 1)
+        for _ in range(elen):
+            t += rng.randrange(0, 2)
+            ents.append(t)
+        committed = rng.randrange(0, si + len(terms) + elen + 2)
+        et = np.zeros((SPEC.E,), np.int32)
+        et[:elen] = ents
+        nodes.append(mk_node(terms, si, st, cm))
+        args.append((base, bt, committed, elen, et, ents))
+        oracles.append(o)
+
+    ns = stack(nodes)
+    base_a = jnp.asarray([a[0] for a in args], jnp.int32)
+    bt_a = jnp.asarray([a[1] for a in args], jnp.int32)
+    cm_a = jnp.asarray([a[2] for a in args], jnp.int32)
+    ln_a = jnp.asarray([a[3] for a in args], jnp.int32)
+    et_a = jnp.asarray(np.stack([a[4] for a in args]))
+
+    fn = jax.jit(
+        jax.vmap(
+            lambda n, i, lt, cm, ln, et: logops.maybe_append(
+                SPEC, n, i, lt, cm, ln, et, et * 0 + 7, et * 0, jnp.bool_(True)
+            )
+        )
+    )
+    n2, lastnewi, ok = fn(ns, base_a, bt_a, cm_a, ln_a, et_a)
+    lastnewi, ok = np.asarray(lastnewi), np.asarray(ok)
+    n2 = jax.tree.map(np.asarray, n2)
+
+    for k, o in enumerate(oracles):
+        base, bt, committed, elen, _, ents = args[k]
+        want_last, want_ok = o.maybe_append(base, bt, committed, ents)
+        assert bool(ok[k]) == want_ok, (k, args[k])
+        if want_ok:
+            assert lastnewi[k] == want_last
+            assert int(n2.commit[k]) == o.commit
+            assert int(n2.last_index[k]) == o.last
+            assert host_window(n2, k) == o.terms, (k, args[k])
+
+
+def test_count_pending_conf():
+    from etcd_tpu.types import ENTRY_CONF_CHANGE
+
+    n = mk_node([1, 1, 1, 2, 2], 0, 0, 4)
+    n = n.replace(log_type=n.log_type.at[2].set(ENTRY_CONF_CHANGE))  # index 3
+    assert int(logops.count_pending_conf(SPEC, n, jnp.int32(0), jnp.int32(4))) == 1
+    assert int(logops.count_pending_conf(SPEC, n, jnp.int32(3), jnp.int32(5))) == 0

@@ -1,0 +1,134 @@
+"""Quorum kernel vs brute-force oracles.
+
+Mirrors the reference's property-based checks (raft/quorum/quick_test.go:122
+checks CommittedIndex against an alternative implementation; majority_*.txt /
+joint_*.txt datadriven cases) with a numpy oracle over randomized configs.
+All cases are evaluated in a single jitted vmap call.
+"""
+import itertools
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from etcd_tpu.ops.quorum import (
+    committed_index,
+    joint_committed_index,
+    joint_vote_result,
+    vote_result,
+)
+from etcd_tpu.types import INT32_MAX, VOTE_LOST, VOTE_PENDING, VOTE_WON
+
+M = 7
+N_CASES = 500
+
+
+def oracle_committed(voters, acked):
+    ids = [i for i in range(len(voters)) if voters[i]]
+    n = len(ids)
+    if n == 0:
+        return INT32_MAX
+    q = n // 2 + 1
+    for idx in sorted({int(acked[i]) for i in ids} | {0}, reverse=True):
+        if sum(1 for i in ids if acked[i] >= idx) >= q:
+            return idx
+    return 0
+
+
+def oracle_vote(voters, responded, granted):
+    ids = [i for i in range(len(voters)) if voters[i]]
+    n = len(ids)
+    if n == 0:
+        return VOTE_WON
+    q = n // 2 + 1
+    yes = sum(1 for i in ids if responded[i] and granted[i])
+    no = sum(1 for i in ids if responded[i] and not granted[i])
+    if yes >= q:
+        return VOTE_WON
+    if yes + (n - yes - no) >= q:
+        return VOTE_PENDING
+    return VOTE_LOST
+
+
+def rand_cases(seed):
+    rng = np.random.RandomState(seed)
+    return (
+        rng.rand(N_CASES, M) < 0.6,        # voters
+        rng.randint(0, 8, (N_CASES, M)),   # acked
+        rng.rand(N_CASES, M) < 0.7,        # responded
+        rng.rand(N_CASES, M) < 0.5,        # granted
+        rng.rand(N_CASES, M) < 0.5,        # voters_out
+    )
+
+
+def test_committed_index_matches_oracle():
+    voters, acked, _, _, _ = rand_cases(1)
+    got = np.asarray(
+        jax.jit(jax.vmap(committed_index))(
+            jnp.array(voters), jnp.array(acked, jnp.int32)
+        )
+    )
+    for i in range(N_CASES):
+        assert got[i] == oracle_committed(voters[i], acked[i]), (
+            voters[i],
+            acked[i],
+        )
+
+
+def test_vote_result_matches_oracle():
+    voters, _, responded, granted, _ = rand_cases(2)
+    got = np.asarray(
+        jax.jit(jax.vmap(vote_result))(
+            jnp.array(voters), jnp.array(responded), jnp.array(granted)
+        )
+    )
+    for i in range(N_CASES):
+        assert got[i] == oracle_vote(voters[i], responded[i], granted[i])
+
+
+def test_joint_committed_is_min_of_halves():
+    v1, acked, _, _, v2 = rand_cases(3)
+    got = np.asarray(
+        jax.jit(jax.vmap(joint_committed_index))(
+            jnp.array(v1), jnp.array(v2), jnp.array(acked, jnp.int32)
+        )
+    )
+    for i in range(N_CASES):
+        want = min(oracle_committed(v1[i], acked[i]), oracle_committed(v2[i], acked[i]))
+        assert got[i] == want
+
+
+def test_joint_vote_combines():
+    v1, _, responded, granted, v2 = rand_cases(4)
+    got = np.asarray(
+        jax.jit(jax.vmap(joint_vote_result))(
+            jnp.array(v1), jnp.array(v2), jnp.array(responded), jnp.array(granted)
+        )
+    )
+    for i in range(N_CASES):
+        r1 = oracle_vote(v1[i], responded[i], granted[i])
+        r2 = oracle_vote(v2[i], responded[i], granted[i])
+        if VOTE_LOST in (r1, r2):
+            want = VOTE_LOST
+        elif r1 == r2 == VOTE_WON:
+            want = VOTE_WON
+        else:
+            want = VOTE_PENDING
+        assert got[i] == want
+
+
+def test_small_exhaustive_majorities():
+    """Exhaustive check for <=5 voters and acked values in {0,1,2}."""
+    cases_v, cases_a = [], []
+    for n in range(6):
+        for acked in itertools.product(range(3), repeat=n):
+            cases_v.append([True] * n + [False] * (M - n))
+            cases_a.append(list(acked) + [0] * (M - n))
+    got = np.asarray(
+        jax.jit(jax.vmap(committed_index))(
+            jnp.array(cases_v), jnp.array(cases_a, jnp.int32)
+        )
+    )
+    for i in range(len(cases_v)):
+        assert got[i] == oracle_committed(cases_v[i], cases_a[i])
