@@ -73,14 +73,14 @@ class Cluster:
 
     # -- faults (raft_test.go:4722-4748) ------------------------------------
     def isolate(self, m: int, c: int | None = None):
-        km = np.asarray(self.eng.keep_mask)
+        km = np.array(self.eng.keep_mask)
         cs = slice(None) if c is None else c
         km[cs, m, :] = False
         km[cs, :, m] = False
         self.eng.keep_mask = jnp.asarray(km)
 
     def cut(self, a: int, b: int, c: int | None = None):
-        km = np.asarray(self.eng.keep_mask)
+        km = np.array(self.eng.keep_mask)
         cs = slice(None) if c is None else c
         km[cs, a, b] = False
         km[cs, b, a] = False
@@ -94,13 +94,13 @@ class Cluster:
             for a in g:
                 for b in g:
                     km[a, b] = True
-        full = np.asarray(self.eng.keep_mask)
+        full = np.array(self.eng.keep_mask)
         cs = slice(None) if c is None else c
         full[cs] = km
         self.eng.keep_mask = jnp.asarray(full)
 
     def recover(self, c: int | None = None):
-        km = np.asarray(self.eng.keep_mask)
+        km = np.array(self.eng.keep_mask)
         cs = slice(None) if c is None else c
         km[cs] = True
         self.eng.keep_mask = jnp.asarray(km)
@@ -142,10 +142,18 @@ class Cluster:
     def roles(self, c: int = 0) -> np.ndarray:
         return np.asarray(self.s.role[c])
 
-    def leader(self, c: int = 0) -> int:
+    def leaders(self, c: int = 0) -> list[int]:
         lead = np.asarray(self.s.role[c]) == ROLE_LEADER
-        ids = np.nonzero(lead)[0]
-        return int(ids[0]) if len(ids) else NONE_ID
+        return [int(i) for i in np.nonzero(lead)[0]]
+
+    def leader(self, c: int = 0) -> int:
+        """The leader at the highest term (an isolated stale leader may
+        coexist, which is legal Raft)."""
+        ids = self.leaders(c)
+        if not ids:
+            return NONE_ID
+        terms = np.asarray(self.s.term[c])
+        return int(max(ids, key=lambda i: terms[i]))
 
     def terms(self, c: int = 0) -> np.ndarray:
         return np.asarray(self.s.term[c])

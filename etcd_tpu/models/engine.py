@@ -89,9 +89,9 @@ def build_round(cfg: RaftConfig, spec: Spec):
             state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup, do_tick
         )
         msgs = ob.msgs  # leaves [C, from, to, K, ...]
-        msgs = msgs.replace(
-            type=jnp.where(keep_mask[..., None], msgs.type, 0)
-        )
+        # self-loops (MsgHup-to-self etc.) are local, never subject to faults
+        keep = keep_mask | jnp.eye(spec.M, dtype=jnp.bool_)[None]
+        msgs = msgs.replace(type=jnp.where(keep[..., None], msgs.type, 0))
         next_inbox = jax.tree.map(lambda x: jnp.swapaxes(x, 1, 2), msgs)
         return state, next_inbox
 

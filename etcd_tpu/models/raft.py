@@ -8,22 +8,28 @@ Ready/Advance apply cycle — as straight-line masked tensor updates over a
 :class:`NodeState`. Every helper is written for ONE node (scalars, [M] peer
 arrays, [L] log ring) and batched by ``jax.vmap`` over the member and
 cluster axes; data-dependent Go control flow becomes ``jnp.where`` masks so
-the whole round jits into a single fused XLA program.
+the whole round jits into one fused XLA program.
+
+Compile-size discipline: the expensive sub-graphs (``process_message``,
+``campaign``/``become_leader``, the conf-change apply) are each traced
+exactly once per round — inbox messages, local proposals, read-index
+requests and the campaign trigger all flow through ONE ``lax.scan`` over a
+message sequence, and the apply loop is a ``lax.scan`` of length Spec.A.
 
 Deviations from the reference, all intentional and documented inline:
-  * The application is fused: committed entries (and snapshots/conf changes)
-    are applied eagerly inside the round (`apply_round`), so Ready/Advance
-    double-buffering collapses; `applied` advances up to Spec.A entries per
-    round, mirroring MaxCommittedSizePerReady pagination (raft.go:149-151).
-  * After the auto-leave config proposal (advance(), raft.go:554-570) we
-    bcastAppend immediately instead of waiting for the next trigger; this
-    only accelerates delivery of a message the reference would send later.
-  * Byte-based quotas (MaxSizePerMsg, MaxUncommittedEntriesSize) are entry
+  * The application is fused: committed entries (and snapshots/conf
+    changes) apply eagerly inside the round, up to Spec.A entries per round
+    (MaxCommittedSizePerReady pagination, raft.go:149-151).
+  * MsgHup is a first-class message; internal campaign triggers
+    (MsgTimeoutNow, a pre-candidate winning its pre-vote) emit MsgHup to
+    self, arriving next round — a legal async schedule.
+  * Ticks run at the START of a round, before message delivery.
+  * After the auto-leave proposal (advance(), raft.go:554-570) we
+    bcastAppend immediately rather than waiting for the next trigger.
+  * Byte quotas (MaxSizePerMsg, MaxUncommittedEntriesSize) are entry
     counts: payloads are fixed-width words on device.
 """
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +46,8 @@ from etcd_tpu.ops import log as logops
 from etcd_tpu.ops import quorum
 from etcd_tpu.ops.outbox import Outbox, bcast, emit, emit_one, empty_outbox, make_msg
 from etcd_tpu.types import (
+    CAMPAIGN_FORCE,
+    CAMPAIGN_NONE,
     CAMPAIGN_TRANSFER,
     ENTRY_CONF_CHANGE,
     ENTRY_NORMAL,
@@ -47,6 +55,7 @@ from etcd_tpu.types import (
     MSG_APP_RESP,
     MSG_HEARTBEAT,
     MSG_HEARTBEAT_RESP,
+    MSG_HUP,
     MSG_NONE,
     MSG_PRE_VOTE,
     MSG_PRE_VOTE_RESP,
@@ -140,7 +149,7 @@ def reset_state(cfg: RaftConfig, spec: Spec, n: NodeState, term) -> NodeState:
         votes_responded=fM,
         votes_granted=fM,
         match=jnp.where(sh, n.last_index, 0),
-        next_idx=jnp.full((spec.M,), 0, jnp.int32) + n.last_index + 1,
+        next_idx=jnp.zeros((spec.M,), jnp.int32) + n.last_index + 1,
         pr_state=jnp.full((spec.M,), PR_PROBE, jnp.int32),
         probe_sent=fM,
         pending_snapshot=jnp.zeros((spec.M,), jnp.int32),
@@ -227,7 +236,7 @@ def append_entries_state(
     )
     cap_over = (n.last_index + add - n.snap_index) > spec.L
     accepted = enable & ~over & ~cap_over
-    terms = jnp.full((spec.E,), 0, jnp.int32) + n.term
+    terms = jnp.zeros((spec.E,), jnp.int32) + n.term
     n2 = logops.append_span(
         spec, n, n.last_index, add, terms, ent_data, ent_type, accepted
     )
@@ -290,16 +299,15 @@ def maybe_send_append(
     dest_mask: [M] bool (self is always excluded). send_if_empty: scalar or
     [M] bool. Falls back to MsgSnap when the needed entries are compacted.
     """
+    send_if_empty = jnp.asarray(send_if_empty, jnp.bool_)
     ids = _ids(spec)
     mask = dest_mask & (ids != n.nid) & ~_is_paused(cfg, n)
 
     prev = n.next_idx - 1  # [M]
     needs_snap = prev < n.snap_index
-    # term(prev) per destination
     t_prev = jnp.where(
         prev == n.snap_index, n.snap_term, n.log_term[logops.slot(spec, prev)]
     )
-    # entries [next, next+E) per destination
     offs = jnp.arange(spec.E, dtype=jnp.int32)[None, :]
     idxs = n.next_idx[:, None] + offs  # [M, E]
     valid = (idxs <= n.last_index) & (idxs > n.snap_index)
@@ -387,48 +395,60 @@ def bcast_heartbeat(cfg, spec, n, ob, ctx, enable) -> tuple[NodeState, Outbox]:
 
 
 # ---------------------------------------------------------------------------
-# campaigning (raft.go:760-845)
+# campaigning (raft.go:760-845); traced ONCE per round via the MsgHup handler
 # ---------------------------------------------------------------------------
 
 
-def campaign(cfg, spec, n: NodeState, ob: Outbox, kind: str, enable):
-    """raft.campaign (raft.go:785-835). `kind` is static: 'pre', 'election'
-    or 'transfer' (transfer skips pre-vote, raft.go:1452-1457)."""
-    if kind == "pre":
-        nc = become_pre_candidate_state(cfg, spec, n)
-        vote_term = nc.term + 1
-        vtype = MSG_PRE_VOTE
+def campaign(cfg, spec, n: NodeState, ob: Outbox, kind, enable):
+    """raft.campaign (raft.go:785-835) with a dynamic CAMPAIGN_* kind.
+
+    kind CAMPAIGN_NONE runs the pre-vote phase first when cfg.pre_vote; an
+    instant pre-vote win (single voter) falls through to the real election
+    in the same call, mirroring the reference's recursion.
+    """
+    kind = jnp.asarray(kind, jnp.int32)
+    if cfg.pre_vote:
+        pre = enable & (kind == CAMPAIGN_NONE)
+        npre = become_pre_candidate_state(cfg, spec, n)
+        npre = record_vote(spec, npre, npre.nid, jnp.bool_(True))
+        won_pre = tally_votes(npre) == VOTE_WON
+        to = pre & ~won_pre & _voter_union(npre) & (_ids(spec) != npre.nid)
+        lt = logops.last_term(spec, npre)
+        msg = bcast(spec, make_msg(spec)).replace(
+            type=jnp.where(to, MSG_PRE_VOTE, MSG_NONE),
+            term=jnp.broadcast_to(npre.term + 1, (spec.M,)),
+            frm=jnp.broadcast_to(npre.nid, (spec.M,)),
+            index=jnp.broadcast_to(npre.last_index, (spec.M,)),
+            log_term=jnp.broadcast_to(lt, (spec.M,)),
+        )
+        ob = emit(spec, ob, to, msg)
+        n = tree_where(pre, npre, n)
+        do_real = enable & jnp.where(pre, won_pre, True)
     else:
-        nc = become_candidate_state(cfg, spec, n)
-        vote_term = nc.term
-        vtype = MSG_VOTE
+        do_real = enable
 
-    nc = record_vote(spec, nc, nc.nid, jnp.bool_(True))
-    won = tally_votes(nc) == VOTE_WON  # single-voter instant win
-
-    to = enable & ~won & _voter_union(nc) & (_ids(spec) != nc.nid)
-    lt = logops.last_term(spec, nc)
+    nr = become_candidate_state(cfg, spec, n)
+    nr = record_vote(spec, nr, nr.nid, jnp.bool_(True))
+    won = tally_votes(nr) == VOTE_WON
+    to = do_real & ~won & _voter_union(nr) & (_ids(spec) != nr.nid)
+    lt = logops.last_term(spec, nr)
     msg = bcast(spec, make_msg(spec)).replace(
-        type=jnp.where(to, vtype, MSG_NONE),
-        term=jnp.broadcast_to(vote_term, (spec.M,)),
-        frm=jnp.broadcast_to(nc.nid, (spec.M,)),
-        index=jnp.broadcast_to(nc.last_index, (spec.M,)),
+        type=jnp.where(to, MSG_VOTE, MSG_NONE),
+        term=jnp.broadcast_to(nr.term, (spec.M,)),
+        frm=jnp.broadcast_to(nr.nid, (spec.M,)),
+        index=jnp.broadcast_to(nr.last_index, (spec.M,)),
         log_term=jnp.broadcast_to(lt, (spec.M,)),
-        context=jnp.full(
-            (spec.M,), CAMPAIGN_TRANSFER if kind == "transfer" else 0, jnp.int32
+        context=jnp.broadcast_to(
+            jnp.where(kind == CAMPAIGN_TRANSFER, CAMPAIGN_TRANSFER, 0), (spec.M,)
         ),
     )
     ob = emit(spec, ob, to, msg)
-
-    if kind == "pre":
-        nc2, ob = campaign(cfg, spec, nc, ob, "election", enable & won)
-        nc = tree_where(won, nc2, nc)
-    else:
-        nc = tree_where(won, become_leader_state(cfg, spec, nc), nc)
-    return tree_where(enable, nc, n), ob
+    nr = tree_where(won, become_leader_state(cfg, spec, nr), nr)
+    n = tree_where(do_real, nr, n)
+    return n, ob
 
 
-def hup(cfg, spec, n, ob, kind: str, enable):
+def hup(cfg, spec, n, ob, kind, enable):
     """raft.hup (raft.go:760-781): guard against campaigning as leader, when
     unpromotable, or with an unapplied conf change in (applied, committed]."""
     pend = logops.count_pending_conf(spec, n, n.applied, n.commit)
@@ -439,6 +459,18 @@ def hup(cfg, spec, n, ob, kind: str, enable):
         & ~((pend > 0) & (n.commit > n.applied))
     )
     return campaign(cfg, spec, n, ob, kind, ok)
+
+
+def _emit_hup_to_self(spec, n, ob, kind, enable):
+    """Queue a MsgHup to self for the next round (used by MsgTimeoutNow and
+    by a pre-candidate that won its pre-vote round)."""
+    return emit_one(
+        spec,
+        ob,
+        n.nid,
+        make_msg(spec, type=MSG_HUP, frm=n.nid, context=kind),
+        enable,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -645,10 +677,6 @@ def handle_snapshot(cfg, spec, n, ob, m: Msg, enable):
     config adopted from the message."""
     sindex, sterm = m.index, m.log_term
     stale = sindex <= n.commit
-    # defense-in-depth: only followers restore (raft.go:1538-1549)
-    not_follower = n.role != ROLE_FOLLOWER
-    nf = become_follower_state(cfg, spec, n, n.term + 1, jnp.int32(NONE_ID))
-    n = tree_where(enable & ~stale & not_follower, nf, n)
 
     mv = unpack_mask(m.c_voters, spec.M)
     mvo = unpack_mask(m.c_voters_out, spec.M)
@@ -658,8 +686,9 @@ def handle_snapshot(cfg, spec, n, ob, m: Msg, enable):
     in_cs = ((mv | mvo | ml) & sh).any()
 
     fast_fwd = logops.match_term(spec, n, sindex, sterm)
-    do_restore = enable & ~stale & ~not_follower & in_cs & ~fast_fwd
-    do_fast = enable & ~stale & ~not_follower & in_cs & fast_fwd
+    follower = n.role == ROLE_FOLLOWER
+    do_restore = enable & ~stale & follower & in_cs & ~fast_fwd
+    do_fast = enable & ~stale & follower & in_cs & fast_fwd
 
     n = tree_where(do_fast, logops.commit_to(n, sindex), n)
 
@@ -695,7 +724,7 @@ def handle_snapshot(cfg, spec, n, ob, m: Msg, enable):
             frm=n.nid,
             index=jnp.where(do_restore, n.last_index, n.commit),
         ),
-        enable & (n.role == ROLE_FOLLOWER),
+        enable & follower,
     )
     return n, ob
 
@@ -720,7 +749,7 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
         | (m.ent_len == 0)
     )
     doprop = is_prop & ~drop
-    # conf-change guards per entry; refused ccs are blanked to empty normal
+    # conf-change entry guards; refused ccs are blanked to empty normal
     already_joint = is_joint(n)
     pend = n.pending_conf_index > n.applied
     e_type = m.ent_type
@@ -744,7 +773,6 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
     is_ri = en & (m.type == MSG_READ_INDEX)
     singleton = _is_singleton(spec, n)
     local = (m.frm == NONE_ID) | (m.frm == n.nid)
-    # singleton fast path
     n = _rs_push(spec, n, m.context, n.commit, is_ri & singleton & local)
     ob = emit_one(
         spec,
@@ -779,8 +807,7 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
     n = n.replace(recent_active=n.recent_active | (fhot & is_ar))
     match_f = n.match[frm_c]
     next_f = n.next_idx[frm_c]
-    state_f = n.pr_state[frm_c]
-    repl_f = state_f == PR_REPLICATE
+    repl_f = n.pr_state[frm_c] == PR_REPLICATE
 
     # reject path (raft.go:1109-1236)
     rej = is_ar & m.reject
@@ -800,18 +827,15 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
     n = n.replace(
         next_idx=jnp.where(fhot & decremented, new_next, n.next_idx),
         probe_sent=jnp.where(fhot & dec_probe, False, n.probe_sent),
-        # replicate -> BecomeProbe (ResetState clears probe_sent/pending/infl)
         pr_state=jnp.where(fhot & dec_repl, PR_PROBE, n.pr_state),
         pending_snapshot=jnp.where(fhot & dec_repl, 0, n.pending_snapshot),
     )
     n = infl.reset(n, fhot & dec_repl)
-    n, ob = maybe_send_append(cfg, spec, n, ob, fhot & decremented, True)
 
     # accept path (raft.go:1237-1282)
     acc = is_ar & ~m.reject
     old_paused_f = _is_paused(cfg, n)[frm_c]
     updated = acc & (m.index > match_f)
-    # MaybeUpdate (progress.go:144-153)
     n = n.replace(
         match=jnp.where(fhot & updated, m.index, n.match),
         next_idx=jnp.where(fhot & acc, jnp.maximum(n.next_idx, m.index + 1), n.next_idx),
@@ -829,19 +853,21 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
         pending_snapshot=jnp.where(fhot & to_repl, 0, n.pending_snapshot),
     )
     n = infl.reset(n, fhot & to_repl)
-    n = infl.free_le(
-        spec, n, fhot & updated & (state_f == PR_REPLICATE), m.index
-    )
+    n = infl.free_le(spec, n, fhot & updated & (state_f == PR_REPLICATE), m.index)
     n2, committed_adv = maybe_commit_state(cfg, spec, n)
     committed_adv = committed_adv & updated
     n = tree_where(committed_adv, n2, n)
     n, ob = _release_pending_read_index(cfg, spec, n, ob, committed_adv)
-    n, ob = bcast_append(cfg, spec, n, ob, committed_adv)
-    n, ob = maybe_send_append(
-        cfg, spec, n, ob, fhot & updated & ~committed_adv & old_paused_f, True
+
+    # merged send: commit-advance broadcast (raft.go:1259-1263) OR
+    # refresh/drain to the acking follower (1264-1276) OR the reject-path
+    # re-probe (1230-1236); one maybe_send_append inlining covers all three.
+    send_dest = jnp.where(
+        committed_adv, _progress_ids(n), fhot & (updated | decremented)
     )
-    # drain loop (raft.go:1275-1276), bounded to one extra batch per resp
-    n, ob = maybe_send_append(cfg, spec, n, ob, fhot & updated, False)
+    send_nonempty = committed_adv | decremented | old_paused_f
+    n, ob = maybe_send_append(cfg, spec, n, ob, send_dest, send_nonempty)
+
     # leadership transfer (raft.go:1278-1281)
     xfer = updated & (m.frm == n.lead_transferee) & (n.match[frm_c] == n.last_index)
     ob = emit_one(
@@ -881,7 +907,6 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
     is_ss = en & (m.type == MSG_SNAP_STATUS) & has_pr & (
         n.pr_state[frm_c] == PR_SNAPSHOT
     )
-    # reject: clear pending first, then BecomeProbe (order matters, 1322-1325)
     pend_f = jnp.where(m.reject, 0, n.pending_snapshot[frm_c])
     probe_next = jnp.maximum(n.match[frm_c] + 1, pend_f + 1)
     n = n.replace(
@@ -925,29 +950,20 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
 
 
 def _step_candidate(cfg, spec, n, ob, m: Msg, en):
-    """stepCandidate (raft/raft.go:1376-1419), shared by candidate and
-    pre-candidate."""
+    """stepCandidate (raft/raft.go:1376-1419). MsgApp/Heartbeat/Snap are
+    handled by the demote-first rewrite in process_message (the candidate has
+    already become a follower by the time dispatch runs), so only the vote
+    responses remain here."""
     pre = n.role == ROLE_PRE_CANDIDATE
     my_resp = jnp.where(pre, MSG_PRE_VOTE_RESP, MSG_VOTE_RESP)
-
-    # MsgApp/MsgHeartbeat/MsgSnap at our term: a leader exists -> follow it
-    lead_msg = en & (
-        (m.type == MSG_APP) | (m.type == MSG_HEARTBEAT) | (m.type == MSG_SNAP)
-    )
-    nf = become_follower_state(cfg, spec, n, m.term, m.frm)
-    n = tree_where(lead_msg, nf, n)
-    n, ob = handle_append_entries(cfg, spec, n, ob, m, lead_msg & (m.type == MSG_APP))
-    n, ob = handle_heartbeat(cfg, spec, n, ob, m, lead_msg & (m.type == MSG_HEARTBEAT))
-    n, ob = handle_snapshot(cfg, spec, n, ob, m, lead_msg & (m.type == MSG_SNAP))
-
-    # vote responses for our candidacy
     is_vr = en & (m.type == my_resp)
     n = tree_where(is_vr, record_vote(spec, n, m.frm, ~m.reject), n)
     res = tally_votes(n)
     won = is_vr & (res == VOTE_WON)
     lost = is_vr & (res == VOTE_LOST)
-    # pre-candidate winning starts the real election (raft.go:1403-1405)
-    n, ob = campaign(cfg, spec, n, ob, "election", won & pre)
+    # pre-candidate winning runs the real election next round via MsgHup
+    # (the reference recurses into campaign(), raft.go:1403-1405)
+    ob = _emit_hup_to_self(spec, n, ob, CAMPAIGN_FORCE, won & pre)
     # candidate winning becomes leader and broadcasts (raft.go:1406-1408)
     won_real = won & ~pre
     n = tree_where(won_real, become_leader_state(cfg, spec, n), n)
@@ -985,10 +1001,12 @@ def _step_follower(cfg, spec, n, ob, m: Msg, en):
     fwd = en & (
         (m.type == MSG_TRANSFER_LEADER) | (m.type == MSG_READ_INDEX)
     ) & (n.lead != NONE_ID)
-    ob = emit_one(spec, ob, n.lead, m.replace(frm=m.frm), fwd)
+    ob = emit_one(spec, ob, n.lead, m, fwd)
 
     # MsgTimeoutNow: campaign immediately, no pre-vote (raft.go:1452-1457)
-    n, ob = hup(cfg, spec, n, ob, "transfer", en & (m.type == MSG_TIMEOUT_NOW))
+    ob = _emit_hup_to_self(
+        spec, n, ob, CAMPAIGN_TRANSFER, en & (m.type == MSG_TIMEOUT_NOW)
+    )
 
     # MsgReadIndexResp -> local ReadState (raft.go:1465-1471)
     n = _rs_push(
@@ -1004,7 +1022,7 @@ def _step_follower(cfg, spec, n, ob, m: Msg, en):
 
 def process_message(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, m: Msg):
     active = m.type != MSG_NONE
-    local = m.term == 0  # MsgProp / forwarded MsgReadIndex / empty slots
+    local = m.term == 0  # MsgProp / MsgHup / forwarded MsgReadIndex / empty
     higher = active & ~local & (m.term > n.term)
     lower = active & ~local & (m.term < n.term)
 
@@ -1052,6 +1070,9 @@ def process_message(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, m: Ms
     )
     proceed = active & ~drop_lease & ~lower
 
+    # ---- MsgHup (raft.go:923-928); the single campaign() inlining
+    n, ob = hup(cfg, spec, n, ob, m.context, proceed & (m.type == MSG_HUP))
+
     # ---- Msg{Pre,}Vote for any role (raft.go:930-978)
     is_vreq = proceed & vote_like
     can_vote = (
@@ -1079,8 +1100,13 @@ def process_message(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, m: Ms
         vote=jnp.where(real_grant, m.frm, n.vote),
     )
 
-    # ---- role dispatch for everything else
-    rest = proceed & ~vote_like
+    # ---- candidates seeing a current leader demote first (raft.go:1390-1398)
+    rest = proceed & ~vote_like & (m.type != MSG_HUP)
+    cand = (n.role == ROLE_CANDIDATE) | (n.role == ROLE_PRE_CANDIDATE)
+    demote = rest & cand & from_is_lead
+    n = tree_where(demote, become_follower_state(cfg, spec, n, m.term, m.frm), n)
+
+    # ---- role dispatch
     n, ob = _step_leader(cfg, spec, n, ob, m, rest & (n.role == ROLE_LEADER))
     n, ob = _step_candidate(
         cfg,
@@ -1095,11 +1121,12 @@ def process_message(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, m: Ms
 
 
 # ---------------------------------------------------------------------------
-# tick (raft.go:645-684)
+# tick (raft.go:645-684); returns an election-fire flag instead of
+# campaigning inline — the campaign runs through the round's MsgHup slot.
 # ---------------------------------------------------------------------------
 
 
-def tick(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, enable):
+def tick_timers(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, enable):
     is_lead = n.role == ROLE_LEADER
 
     # tickElection for followers/candidates (raft.go:645-654)
@@ -1110,10 +1137,8 @@ def tick(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, enable):
             enable & ~is_lead, jnp.where(fire, 0, ee), n.election_elapsed
         )
     )
-    n, ob = hup(cfg, spec, n, ob, "pre" if cfg.pre_vote else "election", fire)
 
     # tickHeartbeat for leaders (raft.go:657-684)
-    is_lead = n.role == ROLE_LEADER  # re-read: hup can't make a leader w/o quorum=1
     ee2 = n.election_elapsed + 1
     et_fire = enable & is_lead & (ee2 >= cfg.election_tick)
     n = n.replace(
@@ -1126,7 +1151,9 @@ def tick(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, enable):
         sh = _self_hot(spec, n)
         granted = n.recent_active | sh
         qa = (
-            quorum.joint_vote_result(n.voters, n.voters_out, _progress_ids(n) | sh, granted)
+            quorum.joint_vote_result(
+                n.voters, n.voters_out, _progress_ids(n) | sh, granted
+            )
             == VOTE_WON
         )
         step_down = et_fire & ~qa
@@ -1156,7 +1183,7 @@ def tick(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, enable):
         )
     )
     n, ob = bcast_heartbeat(cfg, spec, n, ob, _ro_last_ctx(n), hb_fire)
-    return n, ob
+    return n, ob, fire
 
 
 # ---------------------------------------------------------------------------
@@ -1169,7 +1196,9 @@ def apply_round(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox):
     (raft.go:1623-1700), the state-machine hash advances, auto-leave fires
     (raft.go:554-570), and the ring compacts at the applied cursor when near
     capacity (the triggerSnapshot analog, server.go:1088-1104)."""
-    for _ in range(spec.A):
+
+    def body(carry, _):
+        n, ob = carry
         idx = n.applied + 1
         can = idx <= n.commit
         s = logops.slot(spec, idx)
@@ -1189,6 +1218,9 @@ def apply_round(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox):
                 n.uncommitted_size,
             ),
         )
+        return (n, ob), None
+
+    (n, ob), _ = jax.lax.scan(body, (n, ob), None, length=spec.A)
 
     # auto-leave joint config (advance(), raft.go:554-570)
     al = (
@@ -1243,16 +1275,34 @@ def node_round(
     do_hup,      # bool scalar: inject MsgHup (campaign)
     do_tick,     # bool scalar
 ):
-    """One lockstep round for one node: hup -> inbox -> proposals ->
-    read-index -> tick -> apply. Returns (state, outbox)."""
+    """One lockstep round for one node: tick -> [hup, inbox..., prop,
+    read-index] message scan -> apply. Returns (state, outbox)."""
     ob = empty_outbox(spec)
+    n, ob, fire = tick_timers(cfg, spec, n, ob, jnp.asarray(do_tick, jnp.bool_))
 
-    n, ob = hup(
-        cfg, spec, n, ob, "pre" if cfg.pre_vote else "election", do_hup
+    hup_msg = make_msg(spec, frm=n.nid).replace(
+        type=jnp.where(do_hup | fire, MSG_HUP, MSG_NONE),
+        context=jnp.int32(CAMPAIGN_NONE),
+    )
+    prop_msg = make_msg(spec, frm=n.nid).replace(
+        type=jnp.where(prop_len > 0, MSG_PROP, MSG_NONE),
+        ent_len=jnp.asarray(prop_len, jnp.int32),
+        ent_data=prop_data,
+        ent_type=prop_type,
+    )
+    ri_msg = make_msg(spec, frm=n.nid).replace(
+        type=jnp.where(ri_ctx != 0, MSG_READ_INDEX, MSG_NONE),
+        context=jnp.asarray(ri_ctx, jnp.int32),
     )
 
     flat = jax.tree.map(
         lambda x: x.reshape((spec.M * spec.K,) + x.shape[2:]), inbox
+    )
+    seq = jax.tree.map(
+        lambda h, f, p, r: jnp.concatenate(
+            [h[None], f, p[None], r[None]], axis=0
+        ),
+        hup_msg, flat, prop_msg, ri_msg,
     )
 
     def body(carry, m):
@@ -1260,22 +1310,7 @@ def node_round(
         nn, oo = process_message(cfg, spec, nn, oo, m)
         return (nn, oo), None
 
-    (n, ob), _ = jax.lax.scan(body, (n, ob), flat)
+    (n, ob), _ = jax.lax.scan(body, (n, ob), seq)
 
-    pm = make_msg(spec, frm=n.nid).replace(
-        type=jnp.where(prop_len > 0, MSG_PROP, MSG_NONE),
-        ent_len=jnp.asarray(prop_len, jnp.int32),
-        ent_data=prop_data,
-        ent_type=prop_type,
-    )
-    n, ob = process_message(cfg, spec, n, ob, pm)
-
-    rm = make_msg(spec, frm=n.nid).replace(
-        type=jnp.where(ri_ctx != 0, MSG_READ_INDEX, MSG_NONE),
-        context=jnp.asarray(ri_ctx, jnp.int32),
-    )
-    n, ob = process_message(cfg, spec, n, ob, rm)
-
-    n, ob = tick(cfg, spec, n, ob, do_tick)
     n, ob = apply_round(cfg, spec, n, ob)
     return n, ob

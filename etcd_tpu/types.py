@@ -53,7 +53,8 @@ MSG_READ_INDEX_RESP = 13
 MSG_PROP = 14
 MSG_UNREACHABLE = 15
 MSG_SNAP_STATUS = 16
-NUM_MSG_TYPES = 17
+MSG_HUP = 17  # local campaign trigger; Msg.context selects the campaign kind
+NUM_MSG_TYPES = 18
 
 # Entry types (raft.proto:69-74)
 ENTRY_NORMAL = 0
@@ -70,9 +71,11 @@ PR_REPLICATE = 1
 PR_SNAPSHOT = 2
 
 # Campaign types (raft/raft.go:62-71); carried in Msg.context for vote
-# requests so transfer-campaigns can force past the lease check.
-CAMPAIGN_NONE = 0
-CAMPAIGN_TRANSFER = 1
+# requests so transfer-campaigns can force past the lease check, and in
+# MSG_HUP to select the campaign kind.
+CAMPAIGN_NONE = 0       # normal: pre-vote first when cfg.pre_vote
+CAMPAIGN_TRANSFER = 1   # leadership transfer: real election, forces the lease
+CAMPAIGN_FORCE = 2      # real election even under pre_vote (post-prevote hop)
 
 # Conf-change ops, encoded into a conf-change entry's data word.
 # (reference raft.proto:145-153 ConfChangeType)

@@ -1,10 +1,26 @@
 import os
 
-# Tests always run on a virtual 8-device CPU mesh so sharding paths are
-# exercised without TPU hardware (and unit tests stay fast/deterministic).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests run on a virtual 8-device CPU mesh: sharding paths are exercised
+# without TPU hardware and unit tests stay fast and hermetic.
+#
+# NOTE: this environment's sitecustomize registers an "axon" TPU backend and
+# *explicitly* sets jax_platforms="axon,cpu" via jax.config.update at
+# interpreter start, which overrides JAX_PLATFORMS from the environment. We
+# must override it back AFTER importing jax, or every eager op dispatches
+# over the TPU tunnel (~5ms/op, and hangs when the tunnel is down).
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the round program is large; re-running the
+# suite should not re-pay XLA compile time.
+os.makedirs("/root/repo/.jax_cache", exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
