@@ -41,6 +41,7 @@ def init_fleet(
     voters: jnp.ndarray | None = None,
     learners: jnp.ndarray | None = None,
     seed: int = 0,
+    election_tick: int = 10,
 ) -> NodeState:
     """State pytree with leading [C, M] axes. `voters`/`learners` may be
     [M] (shared) or [C, M] masks."""
@@ -55,7 +56,8 @@ def init_fleet(
 
     def one(c, m):
         return init_node(
-            spec, m, voters[c], learners[c], seed=c * 1_000_003 + seed
+            spec, m, voters[c], learners[c], seed=c * 1_000_003 + seed,
+            election_tick=election_tick,
         )
 
     return jax.vmap(
@@ -111,7 +113,9 @@ class RaftEngine:
         seed: int = 0,
     ):
         self.spec, self.cfg, self.C = spec, cfg, C
-        self.state = init_fleet(spec, C, voters, learners, seed)
+        self.state = init_fleet(
+            spec, C, voters, learners, seed, election_tick=cfg.election_tick
+        )
         self.inbox = empty_inbox(spec, C)
         self.keep_mask = jnp.ones((C, spec.M, spec.M), jnp.bool_)
         self._round = jax.jit(build_round(cfg, spec))

@@ -957,10 +957,14 @@ def _step_candidate(cfg, spec, n, ob, m: Msg, en):
     pre = n.role == ROLE_PRE_CANDIDATE
     my_resp = jnp.where(pre, MSG_PRE_VOTE_RESP, MSG_VOTE_RESP)
     is_vr = en & (m.type == my_resp)
+    res_before = tally_votes(n)
     n = tree_where(is_vr, record_vote(spec, n, m.frm, ~m.reject), n)
     res = tally_votes(n)
-    won = is_vr & (res == VOTE_WON)
-    lost = is_vr & (res == VOTE_LOST)
+    # only the response that *transitions* the tally acts: the reference
+    # changes role immediately so later stale responses are ignored; our
+    # pre-candidate stays in role until the MsgHup hop lands, so dedup here.
+    won = is_vr & (res == VOTE_WON) & (res_before != VOTE_WON)
+    lost = is_vr & (res == VOTE_LOST) & (res_before != VOTE_LOST)
     # pre-candidate winning runs the real election next round via MsgHup
     # (the reference recurses into campaign(), raft.go:1403-1405)
     ob = _emit_hup_to_self(spec, n, ob, CAMPAIGN_FORCE, won & pre)

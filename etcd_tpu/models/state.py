@@ -123,12 +123,14 @@ def init_node(
     voters: jnp.ndarray,
     learners: jnp.ndarray | None = None,
     seed: int | jnp.ndarray = 0,
+    election_tick: int = 10,
 ) -> NodeState:
     """A fresh follower at term 0 with the given applied config.
 
     Equivalent to newRaft on a MemoryStorage whose ConfState is already set
     (the way raft_test.go's newTestRaft boots; raft/raft.go:318-370) — the
-    log is empty, commit/applied = 0.
+    log is empty, commit/applied = 0, and like becomeFollower at boot a
+    randomized election timeout in [T, 2T) is drawn.
     """
     M, L, W, R = spec.M, spec.L, spec.W, spec.R
     if learners is None:
@@ -138,6 +140,10 @@ def init_node(
     nid = jnp.asarray(nid, jnp.int32)
     key = jax.random.fold_in(jax.random.PRNGKey(0), jnp.asarray(seed, jnp.int32))
     key = jax.random.fold_in(key, nid)
+    key, sub = jax.random.split(key)
+    rand_to = election_tick + jax.random.randint(
+        sub, (), 0, election_tick, dtype=jnp.int32
+    )
     return NodeState(
         nid=nid,
         term=z, vote=jnp.int32(NONE_ID), commit=z,
@@ -151,7 +157,7 @@ def init_node(
         snap_learners=learners, snap_learners_next=fM,
         snap_auto_leave=jnp.bool_(False),
         election_elapsed=z, heartbeat_elapsed=z,
-        randomized_timeout=jnp.int32(INT32_SAFE_TIMEOUT),
+        randomized_timeout=rand_to,
         rng_key=key,
         match=jnp.zeros((M,), jnp.int32),
         next_idx=jnp.ones((M,), jnp.int32),
@@ -180,11 +186,6 @@ def init_node(
         rs_index=jnp.zeros((R,), jnp.int32),
         rs_count=z,
     )
-
-
-# placeholder large timeout until the first reset_randomized_timeout; real
-# value is drawn in [election_tick, 2*election_tick) on become_follower.
-INT32_SAFE_TIMEOUT = 1 << 20
 
 
 def is_joint(n: NodeState) -> jnp.ndarray:

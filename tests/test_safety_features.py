@@ -1,0 +1,152 @@
+"""PreVote (thesis §9.6), CheckQuorum, leadership transfer (thesis §3.10)
+and linearizable ReadIndex — mirroring raft_test.go's TestPreVote*,
+TestLeaderElectionPreVote, TestCheckQuorum*, TestLeaderTransfer*, and
+TestReadOnlyForNewLeader/node read-index flows.
+"""
+import numpy as np
+
+from etcd_tpu.harness.cluster import Cluster
+from etcd_tpu.types import NONE_ID, ROLE_FOLLOWER, ROLE_LEADER, ROLE_PRE_CANDIDATE, Spec
+from etcd_tpu.utils.config import RaftConfig
+
+PREVOTE = RaftConfig(pre_vote=True)
+CHECKQ = RaftConfig(check_quorum=True)
+
+
+def test_prevote_election():
+    """An election under PreVote completes (pre-vote then real vote)."""
+    cl = Cluster(n_members=3, cfg=PREVOTE)
+    cl.campaign(0)
+    cl.stabilize()
+    assert cl.leader() == 0
+    assert cl.terms().tolist() == [1, 1, 1]
+
+
+def test_prevote_no_term_inflation():
+    """TestPreVoteWithCheckQuorum flavor: an isolated node under PreVote does
+    NOT inflate its term while partitioned, so its return is non-disruptive."""
+    cl = Cluster(n_members=3, cfg=PREVOTE)
+    cl.campaign(0)
+    cl.stabilize()
+    cl.propose(0, 1)
+    cl.stabilize()
+    cl.isolate(2)
+    # node 2 times out repeatedly but only pre-campaigns: term stays 1
+    for _ in range(40):
+        cl.step(tick=True)
+    assert int(cl.terms()[2]) == 1
+    assert cl.roles()[2] in (ROLE_PRE_CANDIDATE, ROLE_FOLLOWER)
+    # leader unharmed
+    assert cl.leader() == 0 and int(cl.terms()[0]) == 1
+    cl.recover()
+    cl.stabilize(tick=True)
+    # rejoins without deposing the leader
+    assert cl.leader() == 0
+    assert cl.terms().tolist() == [1, 1, 1]
+
+
+def test_without_prevote_term_inflates():
+    """Contrast case: without PreVote the isolated node's term grows."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.stabilize()
+    cl.isolate(2)
+    for _ in range(40):
+        cl.step(tick=True)
+    assert int(cl.terms()[2]) > 1
+
+
+def test_check_quorum_leader_steps_down():
+    """TestLeaderElectionWithCheckQuorum: a leader that cannot reach a quorum
+    steps down after an election timeout (raft.go:997-1018)."""
+    cl = Cluster(n_members=3, cfg=CHECKQ)
+    cl.campaign(0)
+    cl.stabilize()
+    assert cl.leader() == 0
+    cl.isolate(0)
+    for _ in range(2 * CHECKQ.election_tick + 2):
+        cl.step(tick=True)
+    assert cl.roles()[0] == ROLE_FOLLOWER
+
+
+def test_check_quorum_lease_protects_leader():
+    """TestFreeStuckCandidateWithCheckQuorum flavor: under CheckQuorum,
+    followers in contact with a live leader refuse votes (the lease check,
+    raft.go:855-862), so a rejoining inflated-term node cannot depose the
+    leader by vote; instead it is re-absorbed."""
+    cl = Cluster(n_members=3, cfg=CHECKQ)
+    cl.campaign(0)
+    cl.stabilize()
+    cl.propose(0, 3)
+    cl.stabilize()
+    cl.isolate(2)
+    for _ in range(35):
+        cl.step(tick=True)
+    inflated = int(cl.terms()[2])
+    assert inflated > 1
+    cl.recover()
+    cl.stabilize(tick=True)
+    for _ in range(12):
+        cl.step(tick=True)
+    cl.stabilize(tick=True)
+    # one leader again; node 2 back in the fold at the (possibly bumped) term
+    lead = cl.leader()
+    assert lead != NONE_ID
+    assert len(set(cl.terms().tolist())) == 1
+    assert np.asarray(cl.s.commit[0]).min() >= 2
+
+
+def test_leader_transfer():
+    """TestLeaderTransferToUpToDateNode: transfer to a caught-up follower
+    completes via MsgTimeoutNow; the old leader steps down."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.stabilize()
+    cl.propose(0, 8)
+    cl.stabilize()
+    # admin injects MsgTransferLeader at the leader, From = transferee (1)
+    import jax.numpy as jnp
+    from etcd_tpu.types import MSG_TRANSFER_LEADER
+
+    ib = cl.eng.inbox
+    ib = ib.replace(
+        type=ib.type.at[0, 0, 1, 0].set(MSG_TRANSFER_LEADER),
+        frm=ib.frm.at[0, 0, 1, 0].set(1),
+        term=ib.term.at[0, 0, 1, 0].set(int(cl.terms()[0])),
+    )
+    cl.eng.inbox = ib
+    cl.stabilize()
+    assert cl.leader() == 1
+    assert int(cl.terms()[1]) == 2
+    assert cl.roles()[0] == ROLE_FOLLOWER
+
+
+def test_read_index():
+    """Linearizable read: leader confirms leadership via a heartbeat quorum
+    round keyed by ctx, then surfaces a ReadState (read_only.go flow)."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.stabilize()
+    cl.propose(0, 4)
+    cl.stabilize()
+    commit_before = int(cl.commits()[0])
+    ctx = cl.read_index(0)
+    cl.stabilize()
+    s = cl.s
+    assert int(s.rs_count[0, 0]) == 1
+    assert int(s.rs_ctx[0, 0, 0]) == ctx
+    assert int(s.rs_index[0, 0, 0]) == commit_before
+
+
+def test_read_index_forwarded_from_follower():
+    """A follower's MsgReadIndex forwards to the leader and the response
+    surfaces at the follower (raft.go:1458-1471)."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.stabilize()
+    ctx = cl.read_index(2)
+    cl.stabilize()
+    s = cl.s
+    assert int(s.rs_count[0, 2]) == 1
+    assert int(s.rs_ctx[0, 2, 0]) == ctx
+    assert int(s.rs_index[0, 2, 0]) == int(cl.commits()[0])
