@@ -1,0 +1,111 @@
+"""Headline benchmark: batched consensus throughput.
+
+Measures lockstep consensus rounds/sec over a fleet of C concurrent
+5-member Raft groups, with one proposal injected per group per round
+(every round is real work: append -> MsgApp fan-out -> quorum commit ->
+apply), and reports group-rounds/sec against the north-star target of
+1M groups x 10k rounds/sec on one v5e-8 (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_GROUP_ROUNDS_PER_SEC = 1_000_000 * 10_000  # 1M groups x 10k rounds/s
+
+
+def main() -> None:
+    from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
+    from etcd_tpu.parallel.mesh import build_scan_rounds, make_fleet_mesh, shard_fleet
+    from etcd_tpu.types import Spec
+    from etcd_tpu.utils.config import RaftConfig
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    # NOTE: with the current clusters-leading layout, XLA pads [C, M, M]
+    # buffers to (8,128) tiles (~41x); clusters-minor layout is the planned
+    # fix. Until then C is sized to fit HBM with padding.
+    C = int(os.environ.get("BENCH_C", 8192 if on_accel else 512))
+    inner = int(os.environ.get("BENCH_ROUNDS", 32 if on_accel else 8))
+    reps = int(os.environ.get("BENCH_REPS", 5 if on_accel else 2))
+
+    spec = Spec(M=5, L=32, E=1, K=4, W=4, R=2, A=2)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True)
+    M, E = spec.M, spec.E
+
+    devs = jax.devices()
+    mesh = make_fleet_mesh(len(devs)) if len(devs) > 1 else None
+
+    state = init_fleet(spec, C, seed=0, election_tick=cfg.election_tick)
+    inbox = empty_inbox(spec, C)
+    keep = jnp.ones((C, M, M), jnp.bool_)
+    z2 = jnp.zeros((C, M), jnp.int32)
+    zp = jnp.zeros((C, M, E), jnp.int32)
+    no_hup = jnp.zeros((C, M), jnp.bool_)
+    tick = jnp.ones((C, M), jnp.bool_)
+    no_tick = jnp.zeros((C, M), jnp.bool_)
+    if mesh is not None:
+        state, inbox, keep = shard_fleet(mesh, state, inbox, keep)
+
+    # -- elect leaders: campaign node 0 everywhere, settle the cascade ------
+    step = (
+        jax.jit(build_round(cfg, spec))
+        if mesh is None
+        else build_scan_rounds(cfg, spec, mesh, rounds=1)
+    )
+    hup0 = no_hup.at[:, 0].set(True)
+    state, inbox = step(state, inbox, z2, zp, zp, z2, hup0, no_tick, keep)
+    for _ in range(12):  # prevote adds a round; settle to all-leaders
+        state, inbox = step(state, inbox, z2, zp, zp, z2, no_hup, no_tick, keep)
+        if int((state.role == 3).sum()) == C:
+            break
+    n_leaders = int((state.role == 3).sum())
+    assert n_leaders == C, f"expected {C} leaders, got {n_leaders}"
+
+    # -- steady state: 1 proposal/group/round at the leader (node 0) --------
+    prop_len = z2.at[:, 0].set(1)
+    prop_data = zp.at[:, 0, 0].set(7)
+    run = build_scan_rounds(cfg, spec, mesh, rounds=inner)
+    args = (prop_len, prop_data, zp, z2, no_hup, tick, keep)
+
+    state, inbox = run(state, inbox, *args)  # compile + warm
+    jax.block_until_ready(state.commit)
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        state, inbox = run(state, inbox, *args)
+        jax.block_until_ready(state.commit)
+        best = min(best, time.perf_counter() - t0)
+
+    rounds_per_sec = inner / best
+    group_rounds_per_sec = C * rounds_per_sec
+
+    # sanity: consensus actually progressed (commit advances ~1/round)
+    min_commit = int(state.commit.min())
+    assert min_commit > 0, "no commits advanced during benchmark"
+
+    print(
+        json.dumps(
+            {
+                "metric": "consensus_group_rounds_per_sec",
+                "value": round(group_rounds_per_sec, 1),
+                "unit": f"group-rounds/s (C={C}, {platform} x{len(devs)}, "
+                f"{rounds_per_sec:.1f} rounds/s)",
+                "vs_baseline": round(
+                    group_rounds_per_sec / BASELINE_GROUP_ROUNDS_PER_SEC, 4
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -131,6 +131,55 @@ class Cluster:
             self.step(tick=tick)
         return self
 
+    # -- whitebox drivers (raft_paper_test.go-style direct message/state
+    # manipulation; the batched analog of constructing a raft struct and
+    # calling r.Step(pb.Message{...}) directly) ------------------------------
+    def set_node(self, m: int, c: int = 0, **fields):
+        """Overwrite scalar state leaves for one node, e.g.
+        set_node(1, term=2, vote=0, role=ROLE_FOLLOWER)."""
+        st = self.eng.state
+        upd = {}
+        for k, v in fields.items():
+            leaf = np.array(getattr(st, k))
+            leaf[c, m] = v
+            upd[k] = jnp.asarray(leaf)
+        self.eng.state = st.replace(**upd)
+
+    def get(self, field: str, m: int, c: int = 0):
+        v = np.asarray(getattr(self.eng.state, field)[c, m])
+        return v.item() if v.ndim == 0 else v
+
+    def inject(self, to: int, frm: int, c: int = 0, slot: int = 0, **fields):
+        """Place a raw message into the pending inbox (delivered next step)."""
+        ib = self.eng.inbox
+        upd = {}
+        fields.setdefault("frm", frm)
+        for k, v in fields.items():
+            leaf = np.array(getattr(ib, k))
+            leaf[c, to, frm, slot] = v
+            upd[k] = jnp.asarray(leaf)
+        self.eng.inbox = ib.replace(**upd)
+
+    def drain(self, c: int = 0):
+        """Drop all pending messages (the fake network's 'filter and discard'
+        move, raft_test.go:4750-4760)."""
+        ib = self.eng.inbox
+        t = np.array(ib.type)
+        t[c] = 0
+        self.eng.inbox = ib.replace(type=jnp.asarray(t))
+
+    def pending(self, c: int = 0):
+        """[(to, frm, slot, type), ...] of undelivered messages."""
+        t = np.asarray(self.eng.inbox.type[c])
+        out = []
+        for to, frm, k in zip(*np.nonzero(t)):
+            out.append((int(to), int(frm), int(k), int(t[to, frm, k])))
+        return out
+
+    def msg_field(self, field: str, to: int, frm: int, slot: int = 0, c: int = 0):
+        v = np.asarray(getattr(self.eng.inbox, field)[c, to, frm, slot])
+        return v.item() if v.ndim == 0 else v
+
     # -- inspection ----------------------------------------------------------
     @property
     def s(self):

@@ -1,0 +1,280 @@
+"""Auth store — users, RBAC roles with key-interval permissions, tokens.
+
+Mirrors ``server/auth/store.go``: bcrypt'd users (scrypt here — stdlib;
+bcrypt is an external dep in the reference, auth/store.go:90 iface area),
+roles grant {READ, WRITE, READWRITE} over key ranges (interval perms cached
+per user, auth/range_perm_cache.go), and every mutation bumps an
+*auth revision* so tokens minted under an older ACL are rejected
+(store.go's authRevision / ErrAuthOldRevision). Token provider is the
+reference's `simple` type: opaque TTL'd random tokens (jwt is config-gated
+there; out of scope until the config system grows a flag for it).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import secrets
+
+
+class AuthError(Exception):
+    pass
+
+
+class ErrAuthNotEnabled(AuthError):
+    pass
+
+
+class ErrUserNotFound(AuthError):
+    pass
+
+
+class ErrUserAlreadyExist(AuthError):
+    pass
+
+
+class ErrRoleNotFound(AuthError):
+    pass
+
+
+class ErrRoleAlreadyExist(AuthError):
+    pass
+
+
+class ErrAuthFailed(AuthError):
+    pass
+
+
+class ErrPermissionDenied(AuthError):
+    pass
+
+
+class ErrInvalidAuthToken(AuthError):
+    pass
+
+
+class ErrAuthOldRevision(AuthError):
+    pass
+
+
+READ, WRITE, READWRITE = 0, 1, 2
+
+
+@dataclasses.dataclass
+class Permission:
+    perm_type: int
+    key: bytes
+    range_end: bytes | None = None
+
+    def covers(self, key: bytes, range_end: bytes | None, write: bool) -> bool:
+        if write and self.perm_type == READ:
+            return False
+        if not write and self.perm_type == WRITE:
+            return False
+        lo, hi = self.key, self.range_end
+        want_hi = range_end if range_end is not None else key + b"\x00"
+        if hi is None:
+            hi = self.key + b"\x00"
+        elif hi == b"\x00":
+            hi = b"\xff" * 64
+        if want_hi == b"\x00":
+            want_hi = b"\xff" * 64
+        return lo <= key and want_hi <= hi
+
+
+@dataclasses.dataclass
+class User:
+    name: str
+    salt: bytes
+    pw_hash: bytes
+    roles: set[str] = dataclasses.field(default_factory=set)
+    no_password: bool = False
+
+
+@dataclasses.dataclass
+class Role:
+    name: str
+    perms: list[Permission] = dataclasses.field(default_factory=list)
+
+
+def _hash(password: str, salt: bytes) -> bytes:
+    return hashlib.scrypt(password.encode(), salt=salt, n=2**10, r=8, p=1)
+
+
+class AuthStore:
+    ROOT_USER = "root"
+    ROOT_ROLE = "root"
+    TOKEN_TTL = 300  # simpleTokenTTL (auth/simple_token.go), in ticks here
+
+    def __init__(self):
+        self.enabled = False
+        self.revision = 1
+        self.users: dict[str, User] = {}
+        self.roles: dict[str, Role] = {}
+        # token -> (username, auth_revision, expiry_tick)
+        self.tokens: dict[str, tuple[str, int, int]] = {}
+        self.now = 0
+
+    def tick(self, n: int = 1) -> None:
+        self.now += n
+        for t in [t for t, (_, _, exp) in self.tokens.items() if exp <= self.now]:
+            del self.tokens[t]
+
+    def _bump(self) -> None:
+        self.revision += 1
+
+    # -- enable/disable (store.go AuthEnable/AuthDisable) --------------------
+    def auth_enable(self) -> None:
+        root = self.users.get(self.ROOT_USER)
+        if root is None:
+            raise ErrUserNotFound("root user does not exist")
+        if self.ROOT_ROLE not in root.roles:
+            raise AuthError("root user does not have root role")
+        self.enabled = True
+        self._bump()
+
+    def auth_disable(self) -> None:
+        self.enabled = False
+        self.tokens.clear()
+        self._bump()
+
+    # -- users ---------------------------------------------------------------
+    def user_add(self, name: str, password: str = "", no_password: bool = False):
+        if name in self.users:
+            raise ErrUserAlreadyExist(name)
+        salt = os.urandom(16)
+        self.users[name] = User(
+            name, salt, b"" if no_password else _hash(password, salt),
+            no_password=no_password,
+        )
+        self._bump()
+
+    def user_delete(self, name: str):
+        if name == self.ROOT_USER and self.enabled:
+            raise AuthError("cannot delete root user while auth is enabled")
+        if name not in self.users:
+            raise ErrUserNotFound(name)
+        del self.users[name]
+        self.tokens = {
+            t: v for t, v in self.tokens.items() if v[0] != name
+        }
+        self._bump()
+
+    def user_change_password(self, name: str, password: str):
+        u = self.users.get(name)
+        if u is None:
+            raise ErrUserNotFound(name)
+        u.salt = os.urandom(16)
+        u.pw_hash = _hash(password, u.salt)
+        self._bump()
+
+    def user_grant_role(self, name: str, role: str):
+        u = self.users.get(name)
+        if u is None:
+            raise ErrUserNotFound(name)
+        if role != self.ROOT_ROLE and role not in self.roles:
+            raise ErrRoleNotFound(role)
+        u.roles.add(role)
+        self._bump()
+
+    def user_revoke_role(self, name: str, role: str):
+        u = self.users.get(name)
+        if u is None:
+            raise ErrUserNotFound(name)
+        u.roles.discard(role)
+        self._bump()
+
+    # -- roles ---------------------------------------------------------------
+    def role_add(self, name: str):
+        if name in self.roles:
+            raise ErrRoleAlreadyExist(name)
+        self.roles[name] = Role(name)
+        self._bump()
+
+    def role_delete(self, name: str):
+        if name == self.ROOT_ROLE:
+            raise AuthError("cannot delete root role")
+        if name not in self.roles:
+            raise ErrRoleNotFound(name)
+        del self.roles[name]
+        for u in self.users.values():
+            u.roles.discard(name)
+        self._bump()
+
+    def role_grant_permission(self, role: str, perm: Permission):
+        r = self.roles.get(role)
+        if r is None:
+            raise ErrRoleNotFound(role)
+        r.perms = [
+            p for p in r.perms
+            if (p.key, p.range_end) != (perm.key, perm.range_end)
+        ] + [perm]
+        self._bump()
+
+    def role_revoke_permission(self, role: str, key: bytes, range_end=None):
+        r = self.roles.get(role)
+        if r is None:
+            raise ErrRoleNotFound(role)
+        r.perms = [
+            p for p in r.perms if (p.key, p.range_end) != (key, range_end)
+        ]
+        self._bump()
+
+    # -- authn (simple token provider) ---------------------------------------
+    def authenticate(self, name: str, password: str) -> str:
+        if not self.enabled:
+            raise ErrAuthNotEnabled()
+        u = self.users.get(name)
+        if u is None:
+            raise ErrAuthFailed()
+        if not u.no_password and _hash(password, u.salt) != u.pw_hash:
+            raise ErrAuthFailed()
+        token = f"{name}.{secrets.token_hex(16)}"
+        self.tokens[token] = (name, self.revision, self.now + self.TOKEN_TTL)
+        return token
+
+    def auth_info(self, token: str) -> tuple[str, int]:
+        """(username, revision) for a live token."""
+        v = self.tokens.get(token)
+        if v is None:
+            raise ErrInvalidAuthToken()
+        name, rev, exp = v
+        if exp <= self.now:
+            del self.tokens[token]
+            raise ErrInvalidAuthToken()
+        return name, rev
+
+    # -- authz (store.go IsPutPermitted/IsRangePermitted + range_perm_cache) -
+    def check(self, token: str, key: bytes, range_end=None, write=False):
+        if not self.enabled:
+            return
+        name, rev = self.auth_info(token)
+        if rev < self.revision:
+            raise ErrAuthOldRevision()
+        self.check_user(name, key, range_end, write)
+
+    def check_user(self, name: str, key: bytes, range_end=None, write=False):
+        if not self.enabled:
+            return
+        u = self.users.get(name)
+        if u is None:
+            raise ErrUserNotFound(name)
+        if self.ROOT_ROLE in u.roles:
+            return
+        for rname in u.roles:
+            r = self.roles.get(rname)
+            if r is None:
+                continue
+            for p in r.perms:
+                if p.covers(key, range_end, write):
+                    return
+        raise ErrPermissionDenied(name)
+
+    def is_admin(self, token: str) -> None:
+        if not self.enabled:
+            return
+        name, rev = self.auth_info(token)
+        if rev < self.revision:
+            raise ErrAuthOldRevision()
+        if self.ROOT_ROLE not in self.users[name].roles:
+            raise ErrPermissionDenied(name)

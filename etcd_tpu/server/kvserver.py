@@ -1,0 +1,714 @@
+"""Replicated KV server runtime over the batched consensus engine.
+
+The reference's ``EtcdServer`` (server/etcdserver/server.go:202) owns the
+raft node, MVCC, lessor and auth store, routes client requests through
+consensus (v3_server.go:643 processInternalRaftRequestOnce: register wait id
+-> Propose -> block until applied), applies committed entries to the state
+machine (server.go:1829-1944), and serves linearizable reads via ReadIndex
+(v3_server.go:709-879).
+
+Here one :class:`EtcdCluster` drives cluster ``c`` of a batched engine; each
+member has its own :class:`MemberState` (watchable MVCC + lessor + auth),
+exactly like each etcd process has its own bbolt. Entry payloads live in a
+host-side request table keyed by the int32 word the device replicates — the
+"payloadRef" scheme of SURVEY.md §7: the device log replicates references,
+the host resolves them at apply time. Apply results flow back through a
+wait-map (pkg/wait/wait.go:33-41 analog) to the blocked caller.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from etcd_tpu.harness.cluster import Cluster
+from etcd_tpu.models import confchange as ccdev
+from etcd_tpu.models.changer import Changer, Config as HostConfig, ConfChangeError
+from etcd_tpu.server.auth import AuthStore
+from etcd_tpu.server.lease import Lessor
+from etcd_tpu.server.mvcc import ErrCompacted, ErrFutureRev, KeyValue
+from etcd_tpu.server.watch import WatchableStore
+from etcd_tpu.types import ENTRY_CONF_CHANGE, NONE_ID, ROLE_LEADER
+
+
+class ServerError(Exception):
+    pass
+
+
+class ErrNoLeader(ServerError):
+    pass
+
+
+class ErrTimeout(ServerError):
+    pass
+
+
+class ErrTooManyRequests(ServerError):
+    """commit-apply gap backpressure (v3_server.go:45,646)."""
+
+
+class ErrNoSpace(ServerError):
+    """NOSPACE alarm raised through consensus (api/v3alarm)."""
+
+
+class ErrCorrupt(ServerError):
+    pass
+
+
+@dataclasses.dataclass
+class ResponseHeader:
+    cluster_id: int
+    member_id: int
+    revision: int
+    raft_term: int
+
+
+@dataclasses.dataclass
+class Op:
+    """clientv3.Op analog (client/v3/op.go)."""
+
+    type: str  # "put" | "range" | "delete"
+    key: bytes
+    value: bytes = b""
+    range_end: bytes | None = None
+    lease: int = 0
+    prev_kv: bool = False
+    limit: int = 0
+    rev: int = 0
+    count_only: bool = False
+
+
+@dataclasses.dataclass
+class Compare:
+    """clientv3.Compare (client/v3/compare.go): target in
+    {version,create,mod,value,lease}, result in {=,!=,>,<}."""
+
+    key: bytes
+    target: str
+    result: str
+    value: Any
+
+
+@dataclasses.dataclass
+class MemberState:
+    """One member's applied state machine bundle."""
+
+    store: WatchableStore
+    lessor: Lessor
+    auth: AuthStore
+    applied_index: int = 0
+    # wait-map: req word -> apply result (pkg/wait analog)
+    results: dict[int, Any] = dataclasses.field(default_factory=dict)
+    alarms: set[str] = dataclasses.field(default_factory=set)
+
+
+class EtcdCluster:
+    """Drives one batched cluster as an etcd-like multi-member deployment."""
+
+    MAX_APPLY_WAIT_ROUNDS = 64
+    MAX_GAP = 5000  # maxGapBetweenApplyAndCommitIndex (v3_server.go:45)
+
+    def __init__(
+        self,
+        n_members: int = 3,
+        cluster: Cluster | None = None,
+        c: int = 0,
+        quota_bytes: int = 0,
+        lease_min_ttl: int = 1,
+    ):
+        self.cl = cluster or Cluster(n_members=n_members)
+        self.c = c
+        self.M = self.cl.spec.M
+        self.quota_bytes = quota_bytes
+        self.requests: dict[int, dict] = {}  # word -> request payload
+        self._next_word = 1
+        self.members = [
+            MemberState(WatchableStore(), Lessor(lease_min_ttl), AuthStore())
+            for _ in range(self.M)
+        ]
+        self._root_token: str | None = None
+
+    # ------------------------------------------------------------------ raft
+    def leader(self) -> int:
+        return self.cl.leader(self.c)
+
+    def ensure_leader(self) -> int:
+        lead = self.leader()
+        if lead == NONE_ID:
+            for _ in range(40):
+                self.tick()
+                lead = self.leader()
+                if lead != NONE_ID:
+                    break
+        if lead == NONE_ID:
+            raise ErrNoLeader()
+        return lead
+
+    def tick(self) -> None:
+        self.cl.step(tick=True)
+        self._pump()
+        for ms in self.members:
+            ms.lessor.tick()
+            ms.auth.tick()
+        self._expire_leases()
+
+    def step(self) -> None:
+        self.cl.step()
+        self._pump()
+
+    def stabilize(self, max_rounds: int = 64) -> None:
+        self.cl.step()
+        self._pump()
+        for _ in range(max_rounds):
+            if self.cl.eng.pending_messages() == 0:
+                break
+            self.cl.step()
+            self._pump()
+
+    # -------------------------------------------------------------- applying
+    def _pump(self) -> None:
+        """Drain newly-applied entries device->host for every member
+        (the applyAll path, server.go:903-1104)."""
+        s = self.cl.s
+        c = self.c
+        applied = np.asarray(s.applied[c])
+        last = np.asarray(s.last_index[c])
+        snap = np.asarray(s.snap_index[c])
+        terms = np.asarray(s.log_term[c])
+        datas = np.asarray(s.log_data[c])
+        types = np.asarray(s.log_type[c])
+        L = self.cl.spec.L
+        for m, ms in enumerate(self.members):
+            hi = int(applied[m])
+            lo = ms.applied_index
+            if hi <= lo:
+                continue
+            # entries still on the ring? (host fell behind a device snapshot)
+            start = max(lo + 1, int(snap[m]) + 1)
+            for idx in range(start, hi + 1):
+                sl = (idx - 1) % L
+                self._apply_entry(
+                    m, ms, idx, int(types[m, sl]), int(datas[m, sl]),
+                    int(terms[m, sl]),
+                )
+            ms.applied_index = hi
+        self._gc_requests()
+
+    def _gc_requests(self) -> None:
+        """Drop request payloads every configured member has applied (the
+        analog of log compaction for the host-side payload table)."""
+        ref = max(range(self.M), key=lambda m: self.members[m].applied_index)
+        s = self.cl.s
+        conf = (
+            np.asarray(s.voters[self.c, ref])
+            | np.asarray(s.voters_out[self.c, ref])
+            | np.asarray(s.learners[self.c, ref])
+        )
+        floor = min(
+            self.members[m].applied_index for m in range(self.M) if conf[m]
+        )
+        for word in [
+            w for w, r in self.requests.items()
+            if r.get("_index", 1 << 62) <= floor
+        ]:
+            del self.requests[word]
+
+    def _apply_entry(self, m, ms, index, etype, word, term) -> None:
+        if etype == ENTRY_CONF_CHANGE:
+            return  # device applied it to the config masks already
+        if word == 0:
+            return  # empty (leader-election) entry
+        req = self.requests.get(word)
+        if req is None:
+            return  # foreign/unknown ref (e.g. replay after restart)
+        req["_index"] = index  # for payload-table GC once all members apply
+        try:
+            res = self._dispatch(m, ms, req)
+        except (ServerError, Exception) as e:  # applier must never crash
+            res = e
+        # only the serving member's wait-map entry has a consumer; recording
+        # results on every member would leak one entry per request per peer
+        if m == req.get("_serve_m"):
+            ms.results[word] = res
+
+    # dispatch of InternalRaftRequest (apply.go:64-99 applierV3 surface)
+    def _dispatch(self, m: int, ms: MemberState, req: dict) -> Any:
+        kind = req["kind"]
+        if kind == "put":
+            return self._apply_put(ms, req)
+        if kind == "delete_range":
+            return self._apply_delete(ms, req)
+        if kind == "txn":
+            return self._apply_txn(ms, req)
+        if kind == "compact":
+            ms.store.kv.compact(req["rev"])
+            return req["rev"]
+        if kind == "lease_grant":
+            l = ms.lessor.grant(req["id"], req["ttl"])
+            return (l.id, l.ttl)
+        if kind == "lease_revoke":
+            keys = ms.lessor.revoke(req["id"])
+            txn = ms.store.kv.write_txn()
+            for k in keys:
+                txn.delete_range(k)
+            txn.end()
+            ms.store.notify(txn.events)
+            return len(keys)
+        if kind == "lease_checkpoint":
+            for lid, rem in req["checkpoints"]:
+                ms.lessor.apply_checkpoint(lid, rem)
+            return True
+        if kind == "alarm":
+            if req["action"] == "activate":
+                ms.alarms.add(req["alarm"])
+            else:
+                ms.alarms.discard(req["alarm"])
+            return sorted(ms.alarms)
+        if kind.startswith("auth_"):
+            return self._apply_auth(ms, kind, req)
+        raise ServerError(f"unknown request kind {kind}")
+
+    def _check_quota(self, ms: MemberState) -> None:
+        if "NOSPACE" in ms.alarms:
+            raise ErrNoSpace()
+
+    def _apply_put(self, ms: MemberState, req: dict):
+        self._check_quota(ms)
+        txn = ms.store.kv.write_txn()
+        prev = None
+        if req.get("prev_kv"):
+            kvs, _, _ = ms.store.kv.range(req["key"])
+            prev = kvs[0] if kvs else None
+        lease = req.get("lease", 0)
+        if lease:
+            ms.lessor.attach(lease, req["key"])
+        else:
+            ms.lessor.detach(req["key"])
+        rev = txn.put(req["key"], req["value"], lease)
+        txn.end()
+        ms.store.notify(txn.events)
+        return {"rev": rev, "prev_kv": prev}
+
+    def _apply_delete(self, ms: MemberState, req: dict):
+        txn = ms.store.kv.write_txn()
+        prev = []
+        if req.get("prev_kv"):
+            prev, _, _ = ms.store.kv.range(req["key"], req.get("range_end"))
+        n = txn.delete_range(req["key"], req.get("range_end"))
+        rev = txn.end()
+        ms.store.notify(txn.events)
+        for ev in txn.events:
+            ms.lessor.detach(ev[1].key)
+        return {"deleted": n, "rev": rev, "prev_kvs": prev}
+
+    def _eval_compare(self, ms: MemberState, cmp: Compare) -> bool:
+        kvs, _, _ = ms.store.kv.range(cmp.key)
+        kv = kvs[0] if kvs else None
+        if cmp.target == "value":
+            actual = kv.value if kv else b""
+        elif cmp.target == "version":
+            actual = kv.version if kv else 0
+        elif cmp.target == "create":
+            actual = kv.create_revision if kv else 0
+        elif cmp.target == "mod":
+            actual = kv.mod_revision if kv else 0
+        elif cmp.target == "lease":
+            actual = kv.lease if kv else 0
+        else:
+            raise ServerError(f"bad compare target {cmp.target}")
+        if cmp.result == "=":
+            return actual == cmp.value
+        if cmp.result == "!=":
+            return actual != cmp.value
+        if cmp.result == ">":
+            return actual > cmp.value
+        if cmp.result == "<":
+            return actual < cmp.value
+        raise ServerError(f"bad compare result {cmp.result}")
+
+    def _apply_txn(self, ms: MemberState, req: dict):
+        self._check_quota(ms)
+        succeeded = all(self._eval_compare(ms, c) for c in req["compare"])
+        ops: list[Op] = req["success"] if succeeded else req["failure"]
+        txn = ms.store.kv.write_txn()
+        results = []
+        for op in ops:
+            if op.type == "put":
+                # lease bookkeeping identical to the standalone put path
+                if op.lease:
+                    ms.lessor.attach(op.lease, op.key)
+                else:
+                    ms.lessor.detach(op.key)
+                results.append(("put", txn.put(op.key, op.value, op.lease)))
+            elif op.type == "delete":
+                n_before = len(txn.events)
+                results.append(("delete", txn.delete_range(op.key, op.range_end)))
+                for ev in txn.events[n_before:]:
+                    ms.lessor.detach(ev[1].key)
+            elif op.type == "range":
+                if op.rev:
+                    kvs, cnt, rv = ms.store.kv.range(
+                        op.key, op.range_end, op.rev, op.limit, op.count_only
+                    )
+                else:
+                    # mid-txn reads observe this txn's earlier writes
+                    kvs, cnt, rv = txn.range(
+                        op.key, op.range_end, op.limit, op.count_only
+                    )
+                results.append(("range", kvs, cnt))
+            else:
+                raise ServerError(f"bad txn op {op.type}")
+        rev = txn.end()
+        ms.store.notify(txn.events)
+        return {"succeeded": succeeded, "responses": results, "rev": rev}
+
+    def _apply_auth(self, ms: MemberState, kind: str, req: dict):
+        a = ms.auth
+        fn = {
+            "auth_enable": lambda: a.auth_enable(),
+            "auth_disable": lambda: a.auth_disable(),
+            "auth_user_add": lambda: a.user_add(
+                req["name"], req.get("password", ""), req.get("no_password", False)
+            ),
+            "auth_user_delete": lambda: a.user_delete(req["name"]),
+            "auth_user_change_password": lambda: a.user_change_password(
+                req["name"], req["password"]
+            ),
+            "auth_user_grant_role": lambda: a.user_grant_role(
+                req["name"], req["role"]
+            ),
+            "auth_user_revoke_role": lambda: a.user_revoke_role(
+                req["name"], req["role"]
+            ),
+            "auth_role_add": lambda: a.role_add(req["name"]),
+            "auth_role_delete": lambda: a.role_delete(req["name"]),
+            "auth_role_grant_permission": lambda: a.role_grant_permission(
+                req["role"], req["perm"]
+            ),
+            "auth_role_revoke_permission": lambda: a.role_revoke_permission(
+                req["role"], req["key"], req.get("range_end")
+            ),
+        }.get(kind)
+        if fn is None:
+            raise ServerError(f"unknown auth request {kind}")
+        fn()
+        return True
+
+    # ------------------------------------------------------- request routing
+    def _propose(self, req: dict, member: int | None = None) -> Any:
+        """processInternalRaftRequestOnce (v3_server.go:643-704)."""
+        lead = self.ensure_leader()
+        at = member if member is not None else lead
+        # backpressure: commit-apply gap (v3_server.go:644-648)
+        s = self.cl.s
+        gap = int(np.asarray(s.commit[self.c, at])) - self.members[at].applied_index
+        if gap > self.MAX_GAP:
+            raise ErrTooManyRequests()
+        word = self._next_word
+        self._next_word += 1
+        req["_serve_m"] = at
+        self.requests[word] = req
+        self.cl.propose(at, word, c=self.c)
+        serving = self.members[at]
+        for _ in range(self.MAX_APPLY_WAIT_ROUNDS):
+            self.step()
+            if word in serving.results:
+                res = serving.results.pop(word)
+                if isinstance(res, Exception):
+                    raise res
+                return res
+        raise ErrTimeout(req["kind"])
+
+    def _header(self, m: int) -> ResponseHeader:
+        s = self.cl.s
+        return ResponseHeader(
+            cluster_id=self.c,
+            member_id=m,
+            revision=self.members[m].store.kv.current_rev,
+            raft_term=int(np.asarray(s.term[self.c, m])),
+        )
+
+    # ------------------------------------------------------------- public KV
+    def put(self, key: bytes, value: bytes, lease: int = 0,
+            prev_kv: bool = False, token: str | None = None):
+        self._authz(token, key, None, write=True)
+        res = self._propose(
+            {"kind": "put", "key": key, "value": value, "lease": lease,
+             "prev_kv": prev_kv}
+        )
+        self._maybe_raise_nospace()
+        return res
+
+    def delete_range(self, key: bytes, range_end: bytes | None = None,
+                     prev_kv: bool = False, token: str | None = None):
+        self._authz(token, key, range_end, write=True)
+        return self._propose(
+            {"kind": "delete_range", "key": key, "range_end": range_end,
+             "prev_kv": prev_kv}
+        )
+
+    def txn(self, compare: list[Compare], success: list[Op],
+            failure: list[Op] | None = None, token: str | None = None):
+        for cmp_ in compare:
+            self._authz(token, cmp_.key, None, write=False)
+        for op in success + (failure or []):
+            self._authz(token, op.key, op.range_end, write=op.type != "range")
+        return self._propose(
+            {"kind": "txn", "compare": compare, "success": success,
+             "failure": failure or []}
+        )
+
+    def range(self, key: bytes, range_end: bytes | None = None, rev: int = 0,
+              limit: int = 0, serializable: bool = False, member: int | None = None,
+              count_only: bool = False, token: str | None = None):
+        """Range: linearizable by default via ReadIndex barrier
+        (v3_server.go:95-133,709)."""
+        self._authz(token, key, range_end, write=False)
+        m = member if member is not None else self.ensure_leader()
+        if not serializable:
+            self.linearizable_read_notify(m)
+        kvs, count, used = self.members[m].store.kv.range(
+            key, range_end, rev, limit, count_only
+        )
+        return {"kvs": kvs, "count": count, "rev": used,
+                "header": self._header(m)}
+
+    def compact(self, rev: int):
+        return self._propose({"kind": "compact", "rev": rev})
+
+    def linearizable_read_notify(self, member: int) -> None:
+        """linearizableReadLoop round (v3_server.go:709-879): ReadIndex, then
+        wait until applied >= read index."""
+        self.ensure_leader()
+        ctx = self.cl.read_index(member, c=self.c)
+        for _ in range(self.MAX_APPLY_WAIT_ROUNDS):
+            self.step()
+            rs_ctx = np.asarray(self.cl.s.rs_ctx[self.c, member])
+            rs_idx = np.asarray(self.cl.s.rs_index[self.c, member])
+            hits = np.nonzero(rs_ctx == ctx)[0]
+            if hits.size:
+                need = int(rs_idx[hits[0]])
+                # consume the ReadStates queue (the app drains rd.ReadStates
+                # every Ready, etcdserver/raft.go:192-200; leaving them would
+                # fill the R-slot device ring and drop later reads)
+                self.cl.set_node(
+                    member, c=self.c,
+                    rs_ctx=np.zeros_like(rs_ctx),
+                    rs_index=np.zeros_like(rs_idx),
+                    rs_count=0,
+                )
+                while self.members[member].applied_index < need:
+                    self.step()
+                return
+        raise ErrTimeout("read index")
+
+    # ---------------------------------------------------------------- leases
+    def lease_grant(self, lease_id: int, ttl: int):
+        lid, granted = self._propose(
+            {"kind": "lease_grant", "id": lease_id, "ttl": ttl}
+        )
+        return {"id": lid, "ttl": granted}
+
+    def lease_revoke(self, lease_id: int):
+        return self._propose({"kind": "lease_revoke", "id": lease_id})
+
+    def lease_keepalive(self, lease_id: int):
+        """Primary lessor renews directly (leasehttp fronted in the ref);
+        replicate a checkpoint so followers learn the new remaining TTL."""
+        lead = self.ensure_leader()
+        ttl = self.members[lead].lessor.renew(lease_id)
+        self._propose(
+            {"kind": "lease_checkpoint",
+             "checkpoints": [(lease_id, ttl)]}
+        )
+        return {"id": lease_id, "ttl": ttl}
+
+    def lease_time_to_live(self, lease_id: int):
+        lead = self.ensure_leader()
+        ttl, keys = self.members[lead].lessor.time_to_live(lease_id)
+        return {"id": lease_id, "ttl": ttl, "keys": keys}
+
+    def leases(self):
+        lead = self.ensure_leader()
+        return sorted(self.members[lead].lessor.leases)
+
+    def _expire_leases(self) -> None:
+        """Leader lessor's due leases become LeaseRevoke proposals
+        (lessor.go runLoop -> server revoke)."""
+        lead = self.leader()
+        if lead == NONE_ID:
+            return
+        lessor = self.members[lead].lessor
+        if not lessor.primary:
+            # promotion follows raft leadership (lessor.go Promote)
+            lessor.promote(extend=self.cl.cfg.election_tick)
+            for m, ms in enumerate(self.members):
+                if m != lead and ms.lessor.primary:
+                    ms.lessor.demote()
+        due = lessor.expired()
+        for i, lid in enumerate(due):
+            try:
+                self._propose({"kind": "lease_revoke", "id": lid})
+            except ServerError:
+                # retry this id and the rest next tick; their heap entries
+                # were popped by expired()
+                lessor.defer_expiry(due[i:])
+                return
+
+    # ----------------------------------------------------------------- watch
+    def watch(self, member: int, key: bytes, range_end: bytes | None = None,
+              start_rev: int = 0, prev_kv: bool = False):
+        return self.members[member].store.watch(key, range_end, start_rev, prev_kv)
+
+    def watch_events(self, member: int, watch_id: int):
+        self.members[member].store.sync_watchers()
+        return self.members[member].store.take_events(watch_id)
+
+    def cancel_watch(self, member: int, watch_id: int) -> bool:
+        return self.members[member].store.cancel(watch_id)
+
+    # ------------------------------------------------------------ membership
+    def member_config(self) -> HostConfig:
+        """Current config from the leader's applied masks."""
+        s = self.cl.s
+        lead = self.ensure_leader()
+        cfg = HostConfig()
+        v = np.asarray(s.voters[self.c, lead])
+        vo = np.asarray(s.voters_out[self.c, lead])
+        l = np.asarray(s.learners[self.c, lead])
+        ln = np.asarray(s.learners_next[self.c, lead])
+        cfg.voters = {i for i in range(self.M) if v[i]}
+        cfg.voters_outgoing = {i for i in range(self.M) if vo[i]}
+        cfg.learners = {i for i in range(self.M) if l[i]}
+        cfg.learners_next = {i for i in range(self.M) if ln[i]}
+        cfg.auto_leave = bool(np.asarray(s.auto_leave[self.c, lead]))
+        cfg.progress = cfg.voters | cfg.voters_outgoing | cfg.learners
+        cfg.progress_learner = set(cfg.learners)
+        return cfg
+
+    def _conf_change(self, ccs, validate) -> None:
+        """mayAddMember-style guard (server.go:1293) then propose the
+        encoded change and wait for it to apply on the leader."""
+        lead = self.ensure_leader()
+        validate(Changer(self.member_config()))  # raises ConfChangeError
+        word = ccdev.encode(ccs)
+        before = self.member_config()
+        self.cl.propose_conf_change(lead, word, c=self.c)
+        self.stabilize()
+        self.stabilize()
+
+    def member_add(self, member_id: int, learner: bool = False):
+        from etcd_tpu.types import CC_ADD_LEARNER, CC_ADD_NODE
+
+        cfg = self.member_config()
+        if member_id in cfg.progress:
+            # membership.ErrIDExists (api/membership/cluster.go AddMember)
+            raise ServerError(f"member {member_id} already exists")
+        op = CC_ADD_LEARNER if learner else CC_ADD_NODE
+        self._conf_change(
+            [(op, member_id)],
+            lambda ch: ch.simple([(op, member_id)]),
+        )
+
+    def member_remove(self, member_id: int):
+        from etcd_tpu.types import CC_REMOVE_NODE
+
+        cfg = self.member_config()
+        if member_id not in cfg.progress:
+            # membership.ErrIDRemoved/NotFound (RemoveMember guards)
+            raise ServerError(f"member {member_id} not found")
+        # strict-reconfig-check analog (mayRemoveMember, server.go:1293):
+        # refuse a removal that would leave no quorum of started members
+        if member_id in cfg.voters and len(cfg.voters) - 1 < 1:
+            raise ServerError("removing last voter would break the cluster")
+        self._conf_change(
+            [(CC_REMOVE_NODE, member_id)],
+            lambda ch: ch.simple([(CC_REMOVE_NODE, member_id)]),
+        )
+
+    def member_promote(self, member_id: int):
+        """PromoteMember with the readiness guard (server.go:1341,1445:
+        learner must be within 90% of the leader's last index)."""
+        from etcd_tpu.types import CC_ADD_NODE
+
+        lead = self.ensure_leader()
+        s = self.cl.s
+        match = int(np.asarray(s.match[self.c, lead, member_id]))
+        last = int(np.asarray(s.last_index[self.c, lead]))
+        if last > 0 and match < last * 9 // 10:
+            raise ServerError("learner is not ready to be promoted")
+        self._conf_change(
+            [(CC_ADD_NODE, member_id)],
+            lambda ch: ch.simple([(CC_ADD_NODE, member_id)]),
+        )
+
+    # ------------------------------------------------------------------ auth
+    def _authz(self, token, key, range_end, write):
+        lead = self.leader()
+        if lead == NONE_ID:
+            return
+        a = self.members[lead].auth
+        if not a.enabled:
+            return
+        if token is None:
+            raise ServerError("auth token required")
+        a.check(token, key, range_end, write)
+
+    def auth_request(self, kind: str, **kw):
+        return self._propose({"kind": kind, **kw})
+
+    def authenticate(self, name: str, password: str) -> str:
+        lead = self.ensure_leader()
+        return self.members[lead].auth.authenticate(name, password)
+
+    # ----------------------------------------------------------- maintenance
+    def status(self, member: int) -> dict:
+        s = self.cl.s
+        ms = self.members[member]
+        return {
+            "leader": self.leader(),
+            "raft_term": int(np.asarray(s.term[self.c, member])),
+            "raft_index": int(np.asarray(s.last_index[self.c, member])),
+            "raft_applied_index": ms.applied_index,
+            "db_size": ms.store.kv.size,
+            "is_learner": bool(np.asarray(s.learners[self.c, member, member])),
+            "alarms": sorted(ms.alarms),
+        }
+
+    def hash_kv(self, member: int, rev: int = 0) -> int:
+        return self.members[member].store.kv.hash_kv(rev)
+
+    def corruption_check(self) -> None:
+        """Cross-member KV-hash comparison at a common revision
+        (etcdserver/corrupt.go): members at the same applied index must have
+        identical hashes."""
+        by_applied: dict[int, set[int]] = {}
+        for m, ms in enumerate(self.members):
+            by_applied.setdefault(ms.applied_index, set()).add(
+                ms.store.kv.hash_kv()
+            )
+        for applied, hashes in by_applied.items():
+            if len(hashes) > 1:
+                raise ErrCorrupt(f"applied={applied} hashes={hashes}")
+
+    def alarm(self, action: str, alarm: str):
+        return self._propose({"kind": "alarm", "action": action, "alarm": alarm})
+
+    def _maybe_raise_nospace(self) -> None:
+        if not self.quota_bytes:
+            return
+        lead = self.leader()
+        if lead == NONE_ID:
+            return
+        ms = self.members[lead]
+        if ms.store.kv.size > self.quota_bytes and "NOSPACE" not in ms.alarms:
+            self.alarm("activate", "NOSPACE")
+
+    def snapshot(self, member: int) -> dict:
+        """Maintenance.Snapshot analog: serialize the member's applied KV."""
+        ms = self.members[member]
+        return {
+            "applied_index": ms.applied_index,
+            "kv": ms.store.kv.to_snapshot(),
+        }

@@ -1,0 +1,353 @@
+"""Multi-version KV store — the host-side applied state machine.
+
+Mirrors the reference's ``server/storage/mvcc`` semantics with an idiomatic
+Python layout (the device engine replicates *entry references*; each member
+applies them to one of these stores, like each etcd node applies to its own
+bbolt):
+
+  * every write gets a ``revision{main, sub}`` (mvcc/revision.go): main
+    increments once per applied txn, sub per op within it.
+  * ``treeIndex`` (mvcc/index.go:25-52) maps key -> keyIndex; here a dict of
+    key -> KeyIndex plus a lazily-sorted key list for range scans (bisect
+    stands in for the google/btree of degree 32).
+  * ``KeyIndex`` (mvcc/key_index.go:70-74) keeps *generations* separated by
+    tombstones so historical reads at any revision resolve correctly.
+  * reads at a revision walk the index, then fetch values from the revision-
+    keyed store (the bbolt "key" bucket analog, schema/bucket.go:97).
+  * compaction (mvcc/kvstore_compaction.go) drops versions <= compact_rev
+    except each key's latest, and whole keys whose latest is a tombstone.
+
+Sizes are tracked so the quota/alarm path (NOSPACE) has something to check.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+
+
+class MVCCError(Exception):
+    pass
+
+
+class ErrCompacted(MVCCError):
+    """mvcc.ErrCompacted: requested rev <= compacted revision."""
+
+
+class ErrFutureRev(MVCCError):
+    """mvcc.ErrFutureRev: requested rev > current revision."""
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Revision:
+    main: int
+    sub: int = 0
+
+
+@dataclasses.dataclass
+class KeyValue:
+    """mvccpb.KeyValue (api/mvccpb/kv.proto)."""
+
+    key: bytes
+    value: bytes
+    create_revision: int
+    mod_revision: int
+    version: int
+    lease: int = 0
+
+
+class KeyIndex:
+    """key_index.go: per-key revision history in generations."""
+
+    __slots__ = ("key", "generations")
+
+    def __init__(self, key: bytes):
+        self.key = key
+        self.generations: list[list[Revision]] = []
+
+    def put(self, rev: Revision) -> None:
+        if not self.generations:
+            self.generations.append([])
+        self.generations[-1].append(rev)
+
+    def tombstone(self, rev: Revision) -> None:
+        self.put(rev)
+        self.generations.append([])  # open a fresh (empty) generation
+
+    def _walk(self, at_rev: int):
+        """(gi, revs_visible) for the generation live at at_rev, where
+        revs_visible are its revisions with main <= at_rev (key_index.go
+        findGeneration + walk)."""
+        for gi in range(len(self.generations) - 1, -1, -1):
+            gen = self.generations[gi]
+            if not gen or gen[0].main > at_rev:
+                continue
+            vis = [r for r in gen if r.main <= at_rev]
+            if not vis:
+                return None
+            # closed generation whose visible tail is its tombstone => dead
+            closed = gi < len(self.generations) - 1
+            if closed and vis[-1] == gen[-1]:
+                return None
+            return gi, vis
+        return None
+
+    def get(self, at_rev: int) -> Revision | None:
+        """Latest live revision <= at_rev, or None if absent/tombstoned."""
+        hit = self._walk(at_rev)
+        return hit[1][-1] if hit else None
+
+    def created_version(self, at_rev: int) -> tuple[Revision, int] | None:
+        """(create_revision, version) for the generation live at at_rev."""
+        hit = self._walk(at_rev)
+        if not hit:
+            return None
+        gi, vis = hit
+        return self.generations[gi][0], len(vis)
+
+    def compact(self, at_rev: int) -> bool:
+        """Drop revisions <= at_rev except the live one; returns True when
+        the whole keyIndex is empty and should be removed."""
+        new_gens: list[list[Revision]] = []
+        for gi, gen in enumerate(self.generations):
+            if not gen:
+                new_gens.append(gen)
+                continue
+            closed = gi < len(self.generations) - 1
+            if closed and gen[-1].main <= at_rev:
+                continue  # whole generation (incl. tombstone) compacted away
+            keep = [r for r in gen if r.main > at_rev]
+            live = [r for r in gen if r.main <= at_rev]
+            if live and not (closed and live[-1] == gen[-1]):
+                keep = [live[-1]] + keep
+            new_gens.append(keep)
+        # drop leading empties
+        while len(new_gens) > 1 and not new_gens[0]:
+            new_gens.pop(0)
+        self.generations = new_gens
+        return all(not g for g in self.generations)
+
+
+class MVCCStore:
+    """mvcc.store (kvstore.go:59-87) + treeIndex, single-writer."""
+
+    def __init__(self):
+        self.index: dict[bytes, KeyIndex] = {}
+        self._sorted_keys: list[bytes] = []
+        self._sorted_dirty = False
+        # revision-keyed value store: (main, sub) -> KeyValue (+ tombstone flag)
+        self.revs: dict[tuple[int, int], tuple[KeyValue, bool]] = {}
+        self.current_rev = 1  # reference boots at rev 1 (kvstore.go:91-113)
+        self.compact_rev = 0
+        self.size = 0
+
+    # -- internals ----------------------------------------------------------
+    def _keys(self) -> list[bytes]:
+        if self._sorted_dirty:
+            self._sorted_keys = sorted(self.index.keys())
+            self._sorted_dirty = False
+        return self._sorted_keys
+
+    def _range_keys(self, key: bytes, range_end: bytes | None) -> list[bytes]:
+        """etcd range semantics: range_end None => single key; b'\\0' =>
+        from key to end; else half-open [key, range_end)."""
+        if range_end is None:
+            return [key] if key in self.index else []
+        ks = self._keys()
+        lo = bisect.bisect_left(ks, key)
+        if range_end == b"\x00":
+            return ks[lo:]
+        hi = bisect.bisect_left(ks, range_end)
+        return ks[lo:hi]
+
+    def _check_rev(self, rev: int) -> int:
+        if rev <= 0 or rev > self.current_rev:
+            if rev > self.current_rev:
+                raise ErrFutureRev(rev)
+            return self.current_rev
+        if rev < self.compact_rev:
+            raise ErrCompacted(rev)
+        return rev
+
+    # -- txn API (kvstore_txn.go) -------------------------------------------
+    def write_txn(self) -> "WriteTxn":
+        return WriteTxn(self)
+
+    def range(
+        self,
+        key: bytes,
+        range_end: bytes | None = None,
+        rev: int = 0,
+        limit: int = 0,
+        count_only: bool = False,
+    ) -> tuple[list[KeyValue], int, int]:
+        """(kvs, count, rev_used). rev=0 means current."""
+        at = self._check_rev(rev if rev > 0 else self.current_rev)
+        return self._range_at(at, key, range_end, limit, count_only)
+
+    def _range_at(
+        self,
+        at: int,
+        key: bytes,
+        range_end: bytes | None = None,
+        limit: int = 0,
+        count_only: bool = False,
+    ) -> tuple[list[KeyValue], int, int]:
+        kvs: list[KeyValue] = []
+        count = 0
+        for k in self._range_keys(key, range_end):
+            ki = self.index.get(k)
+            if ki is None:
+                continue
+            r = ki.get(at)
+            if r is None:
+                continue
+            count += 1
+            if count_only:
+                continue
+            if limit and len(kvs) >= limit:
+                continue
+            kv, tomb = self.revs[(r.main, r.sub)]
+            if not tomb:
+                kvs.append(kv)
+        return kvs, count, at
+
+    def compact(self, rev: int) -> None:
+        if rev <= self.compact_rev:
+            raise ErrCompacted(rev)
+        if rev > self.current_rev:
+            raise ErrFutureRev(rev)
+        self.compact_rev = rev
+        dead_keys = []
+        for k, ki in self.index.items():
+            if ki.compact(rev):
+                dead_keys.append(k)
+        for k in dead_keys:
+            del self.index[k]
+        self._sorted_dirty = True
+        keep = set()
+        for ki in self.index.values():
+            for gen in ki.generations:
+                for r in gen:
+                    keep.add((r.main, r.sub))
+        for rk in [rk for rk in self.revs if rk[0] <= rev and rk not in keep]:
+            kv, _ = self.revs.pop(rk)
+            self.size -= len(kv.key) + len(kv.value)
+
+    def hash_kv(self, rev: int = 0) -> int:
+        """Maintenance/HashKV analog (mvcc/hash.go): order-independent-free
+        digest of live revision data up to rev."""
+        import zlib
+
+        at = rev if rev > 0 else self.current_rev
+        h = 0
+        for (main, sub), (kv, tomb) in sorted(self.revs.items()):
+            if main > at:
+                continue
+            rec = b"%d/%d/%s/%s/%d" % (main, sub, kv.key, kv.value, tomb)
+            h = zlib.crc32(rec, h)
+        return h
+
+    # -- snapshot (Maintenance.Snapshot / etcdutl analog) --------------------
+    def to_snapshot(self) -> dict:
+        return {
+            "current_rev": self.current_rev,
+            "compact_rev": self.compact_rev,
+            "revs": [
+                (m, s, kv.key, kv.value, kv.create_revision, kv.mod_revision,
+                 kv.version, kv.lease, tomb)
+                for (m, s), (kv, tomb) in sorted(self.revs.items())
+            ],
+            "index": [
+                (k, [[(r.main, r.sub) for r in gen] for gen in ki.generations])
+                for k, ki in sorted(self.index.items())
+            ],
+        }
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "MVCCStore":
+        st = cls()
+        st.current_rev = snap["current_rev"]
+        st.compact_rev = snap["compact_rev"]
+        for m, s, k, v, cr, mr, ver, lease, tomb in snap["revs"]:
+            st.revs[(m, s)] = (KeyValue(k, v, cr, mr, ver, lease), tomb)
+            st.size += len(k) + len(v)
+        for k, gens in snap["index"]:
+            ki = KeyIndex(k)
+            ki.generations = [[Revision(m, s) for m, s in gen] for gen in gens]
+            st.index[k] = ki
+        st._sorted_dirty = True
+        return st
+
+
+class WriteTxn:
+    """One applied entry's write transaction: all ops share revision main =
+    current_rev + 1, distinct subs (kvstore_txn.go:127-240); End() bumps
+    current_rev and reports events for the watch layer
+    (watchable_store_txn.go:22)."""
+
+    def __init__(self, store: MVCCStore):
+        self.s = store
+        self.main = store.current_rev + 1
+        self.sub = 0
+        self.events: list[tuple[str, KeyValue, KeyValue | None]] = []
+        self._wrote = False
+
+    def range(self, key: bytes, range_end: bytes | None = None,
+              limit: int = 0, count_only: bool = False):
+        """Read *inside* the txn: sees this txn's own earlier writes
+        (kvstore_txn.go's read buffer over the uncommitted batch)."""
+        return self.s._range_at(self.main, key, range_end, limit, count_only)
+
+    def put(self, key: bytes, value: bytes, lease: int = 0) -> int:
+        s = self.s
+        rev = Revision(self.main, self.sub)
+        ki = s.index.get(key)
+        if ki is None:
+            ki = KeyIndex(key)
+            s.index[key] = ki
+            s._sorted_dirty = True
+        # visibility at self.main: ops in this txn see earlier ops of the
+        # same txn (intra-txn read-your-writes, kvstore_txn.go tx buffer)
+        prev = ki.created_version(self.main)
+        if prev is None:
+            create, version = rev, 1
+        else:
+            create, version = prev[0], prev[1] + 1
+        prev_kv = None
+        pr = ki.get(self.main)
+        if pr is not None:
+            prev_kv = s.revs[(pr.main, pr.sub)][0]
+        ki.put(rev)
+        kv = KeyValue(key, value, create.main, rev.main, version, lease)
+        s.revs[(rev.main, rev.sub)] = (kv, False)
+        s.size += len(key) + len(value)
+        self.events.append(("put", kv, prev_kv))
+        self.sub += 1
+        self._wrote = True
+        return rev.main
+
+    def delete_range(self, key: bytes, range_end: bytes | None = None) -> int:
+        s = self.s
+        deleted = 0
+        for k in list(s._range_keys(key, range_end)):
+            ki = s.index.get(k)
+            if ki is None:
+                continue
+            live = ki.get(self.main)  # sees this txn's own writes
+            if live is None:
+                continue
+            rev = Revision(self.main, self.sub)
+            prev_kv = s.revs[(live.main, live.sub)][0]
+            ki.tombstone(rev)
+            kv = KeyValue(k, b"", 0, rev.main, 0)
+            s.revs[(rev.main, rev.sub)] = (kv, True)
+            self.events.append(("delete", kv, prev_kv))
+            self.sub += 1
+            deleted += 1
+            self._wrote = True
+        return deleted
+
+    def end(self) -> int:
+        if self._wrote:
+            self.s.current_rev = self.main
+        return self.s.current_rev

@@ -1,0 +1,169 @@
+"""Watch layer over the MVCC store.
+
+Mirrors ``server/storage/mvcc/watchable_store.go``: watchers live in a
+*synced* group (caught up; notified inline at write-txn end,
+watchable_store_txn.go:22) or an *unsynced* group (start revision in the
+past; drained by a catch-up pass reading history — syncWatchersLoop,
+watchable_store.go:211,331). Slow receivers move to a *victims* list and are
+retried (watchable_store.go:47-67). Range membership uses simple interval
+checks (the reference's adt.IntervalTree in watcher_group.go:293 — at host
+scale a linear scan over active watchers is the right-sized structure).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from etcd_tpu.server.mvcc import KeyValue, MVCCStore
+
+
+@dataclasses.dataclass
+class Event:
+    """mvccpb.Event."""
+
+    type: str  # "put" | "delete"
+    kv: KeyValue
+    prev_kv: KeyValue | None = None
+
+
+@dataclasses.dataclass
+class Watcher:
+    id: int
+    key: bytes
+    range_end: bytes | None
+    start_rev: int  # next revision this watcher needs
+    prev_kv: bool = False
+    fragment: bool = False
+    buffer: list[Event] = dataclasses.field(default_factory=list)
+    # victim: buffer overflowed; excluded from synced until retried
+    victim: bool = False
+    compacted: bool = False
+
+    MAX_BUFFER = 1024  # chanBufLen analog (watcher.go)
+
+    def matches(self, key: bytes) -> bool:
+        if self.range_end is None:
+            return key == self.key
+        if self.range_end == b"\x00":
+            return key >= self.key
+        return self.key <= key < self.range_end
+
+
+class WatchableStore:
+    """One member's watchable MVCC store."""
+
+    def __init__(self, store: MVCCStore | None = None):
+        self.kv = store or MVCCStore()
+        self.synced: dict[int, Watcher] = {}
+        self.unsynced: dict[int, Watcher] = {}
+        self._next_id = 1
+
+    # -- watch lifecycle (watcher.go watchStream.Watch) ----------------------
+    def watch(
+        self,
+        key: bytes,
+        range_end: bytes | None = None,
+        start_rev: int = 0,
+        prev_kv: bool = False,
+        watch_id: int = 0,
+    ) -> Watcher:
+        if watch_id == 0:
+            watch_id = self._next_id
+        self._next_id = max(self._next_id, watch_id) + 1
+        cur = self.kv.current_rev
+        if start_rev == 0:
+            start_rev = cur + 1
+        w = Watcher(watch_id, key, range_end, start_rev, prev_kv)
+        if start_rev > cur:
+            self.synced[watch_id] = w  # watchable_store.go:47-63
+        else:
+            self.unsynced[watch_id] = w
+        return w
+
+    def cancel(self, watch_id: int) -> bool:
+        return (
+            self.synced.pop(watch_id, None) is not None
+            or self.unsynced.pop(watch_id, None) is not None
+        )
+
+    # -- write-path publication (watchable_store_txn.go:22) ------------------
+    def notify(self, events: list[tuple[str, KeyValue, KeyValue | None]]):
+        for typ, kv, prev in events:
+            for w in self.synced.values():
+                if w.victim or not w.matches(kv.key):
+                    continue
+                if len(w.buffer) >= Watcher.MAX_BUFFER:
+                    # slow watcher becomes a victim; it will be re-synced
+                    # from history later (victims queue)
+                    w.victim = True
+                    w.start_rev = kv.mod_revision
+                    continue
+                w.buffer.append(
+                    Event(typ, kv, prev if w.prev_kv else None)
+                )
+                w.start_rev = kv.mod_revision + 1
+
+    def apply_txn_events(self, txn_events) -> None:
+        self.notify(txn_events)
+
+    # -- catch-up (syncWatchersLoop, watchable_store.go:211-331) -------------
+    def sync_watchers(self, batch: int = 512) -> int:
+        """One catch-up pass: move ready unsynced/victim watchers to synced,
+        emitting their missed history. Returns number synced."""
+        moved = 0
+        # victims rejoin the unsynced path
+        for wid, w in list(self.synced.items()):
+            if w.victim:
+                del self.synced[wid]
+                self.unsynced[wid] = w
+        cur = self.kv.current_rev
+        for wid, w in list(self.unsynced.items()):
+            if w.start_rev <= self.kv.compact_rev:
+                w.compacted = True  # client must restart (ErrCompacted)
+                del self.unsynced[wid]
+                moved += 1
+                continue
+            evs = self._history(w, w.start_rev, cur)
+            room = Watcher.MAX_BUFFER - len(w.buffer)
+            if len(evs) > room:
+                # split only at a main-revision boundary: a multi-op txn's
+                # events share one mod_revision, and resuming mid-revision
+                # would re-emit the already-buffered part of it
+                split = room
+                while (
+                    split > 0
+                    and evs[split].kv.mod_revision
+                    == evs[split - 1].kv.mod_revision
+                ):
+                    split -= 1
+                if split == 0:
+                    continue  # no room for a whole revision yet
+                w.buffer.extend(evs[:split])
+                w.start_rev = evs[split].kv.mod_revision
+                continue  # still unsynced
+            w.buffer.extend(evs)
+            w.start_rev = cur + 1
+            w.victim = False
+            del self.unsynced[wid]
+            self.synced[wid] = w
+            moved += 1
+        return moved
+
+    def _history(self, w: Watcher, lo: int, hi: int) -> list[Event]:
+        """Events for w in revision range [lo, hi] from the rev-keyed store
+        (the kvsToEvents read of the backend, watchable_store.go:331)."""
+        out = []
+        for (main, sub), (kv, tomb) in sorted(self.kv.revs.items()):
+            if main < lo or main > hi:
+                continue
+            if not w.matches(kv.key):
+                continue
+            out.append(Event("delete" if tomb else "put", kv))
+        return out
+
+    # -- consumption (serverWatchStream sendLoop analog) ---------------------
+    def take_events(self, watch_id: int) -> list[Event]:
+        w = self.synced.get(watch_id) or self.unsynced.get(watch_id)
+        if w is None:
+            return []
+        evs, w.buffer = w.buffer, []
+        return evs

@@ -1,0 +1,102 @@
+"""Fleet checkpoint/restore — the WAL+snapshot pair at device scale.
+
+The reference persists per-node HardState+entries in the WAL on every Ready
+(server/etcdserver/raft.go:236) and cuts full snapshots every SnapshotCount
+applied entries (server.go:1088-1104). At fleet scale the equivalent is:
+
+  * full-state device->host snapshots every N rounds (one npz of the whole
+    [C, M] pytree — HardState, log ring, trackers, RNG keys), and
+  * per-round HardState/entry *deltas* appended to a WAL for the clusters
+    the host is actively serving (EtcdCluster integration tier).
+
+Restore rebuilds the exact NodeState pytree; because the engine is
+deterministic given (state, inputs), replaying the same proposal schedule
+reproduces the same fleet — the deterministic-replay contract of
+SURVEY.md §5 checkpoint/resume.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from etcd_tpu.models.state import NodeState
+from etcd_tpu.types import Spec
+
+
+def _leaf_names(state: NodeState) -> list[str]:
+    return [f.name for f in state.__dataclass_fields__.values()]
+
+
+def save_fleet(path: str, state: NodeState, round_no: int = 0,
+               extra: dict | None = None) -> None:
+    """Atomic full-fleet snapshot (write-temp + rename, like the reference's
+    snap file discipline in api/snap/snapshotter.go)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arrays = {
+        name: np.asarray(getattr(state, name)) for name in _leaf_names(state)
+    }
+    meta = {"round": round_no, "extra": extra or {}}
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, __meta__=json.dumps(meta), **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_fleet(path: str) -> tuple[NodeState, dict]:
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        kw = {k: jax.numpy.asarray(z[k]) for k in z.files if k != "__meta__"}
+    return NodeState(**kw), meta
+
+
+class FleetCheckpointer:
+    """Every-N-rounds snapshot rotation with retention (the triggerSnapshot
+    cadence, server.go:72 DefaultSnapshotCount)."""
+
+    def __init__(self, dirpath: str, every: int = 1000, keep: int = 3):
+        self.dir = dirpath
+        self.every = every
+        self.keep = keep
+        self.round = 0
+        os.makedirs(dirpath, exist_ok=True)
+
+    def maybe_save(self, state: NodeState, rounds_advanced: int = 1) -> bool:
+        self.round += rounds_advanced
+        if self.round % self.every:
+            return False
+        self.save(state)
+        return True
+
+    def save(self, state: NodeState) -> str:
+        path = os.path.join(self.dir, f"fleet-{self.round:012d}.npz")
+        save_fleet(path, state, self.round)
+        self._gc()
+        return path
+
+    def latest(self) -> str | None:
+        snaps = sorted(
+            f for f in os.listdir(self.dir)
+            if f.startswith("fleet-") and f.endswith(".npz")
+        )
+        return os.path.join(self.dir, snaps[-1]) if snaps else None
+
+    def restore(self) -> tuple[NodeState, dict] | None:
+        p = self.latest()
+        if p is None:
+            return None
+        state, meta = load_fleet(p)
+        self.round = meta["round"]
+        return state, meta
+
+    def _gc(self) -> None:
+        snaps = sorted(
+            f for f in os.listdir(self.dir)
+            if f.startswith("fleet-") and f.endswith(".npz")
+        )
+        for f in snaps[: -self.keep]:
+            os.remove(os.path.join(self.dir, f))

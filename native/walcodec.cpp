@@ -1,0 +1,108 @@
+// WAL record codec — the native hot path of the host durability ring.
+//
+// Plays the role of the reference's encoder/decoder pair
+// (server/storage/wal/encoder.go:124, decoder.go:196): length-prefixed
+// records with a running CRC32 chain so a torn tail is detected at the
+// first bad frame (wal/repair.go's openAtTail contract). Layout per record:
+//
+//   u32 payload_len | u8 type | u32 crc | payload bytes | pad to 8
+//
+// crc = crc32(prev_crc, payload) — chained, so records can't be reordered.
+// Exposed as a C ABI for ctypes (pybind11 is not in this image).
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// CRC32 (IEEE, reflected) — table-driven, same polynomial as Go's
+// hash/crc32.IEEETable used by the reference WAL.
+uint32_t crc_table[256];
+bool table_init = false;
+
+void init_table() {
+  if (table_init) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  table_init = true;
+}
+
+uint32_t crc32_update(uint32_t crc, const uint8_t* buf, size_t len) {
+  init_table();
+  crc = ~crc;
+  for (size_t i = 0; i < len; i++) crc = crc_table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+constexpr size_t kHeader = 9;  // u32 len + u8 type + u32 crc
+
+inline size_t padded(size_t n) { return (n + 7) & ~size_t(7); }
+
+}  // namespace
+
+extern "C" {
+
+uint32_t wal_crc32(uint32_t crc, const uint8_t* buf, uint64_t len) {
+  return crc32_update(crc, buf, len);
+}
+
+// Frame one record into out (caller sizes out >= wal_frame_size(len)).
+// Returns bytes written; *crc_io is the running chain crc (in/out).
+uint64_t wal_encode(uint8_t type, const uint8_t* payload, uint64_t len,
+                    uint32_t* crc_io, uint8_t* out) {
+  uint32_t crc = crc32_update(*crc_io, payload, len);
+  *crc_io = crc;
+  uint32_t l32 = (uint32_t)len;
+  std::memcpy(out, &l32, 4);
+  out[4] = type;
+  std::memcpy(out + 5, &crc, 4);
+  std::memcpy(out + kHeader, payload, len);
+  size_t total = kHeader + len;
+  size_t want = kHeader + padded(len);
+  for (size_t i = total; i < want; i++) out[i] = 0;
+  return want;
+}
+
+uint64_t wal_frame_size(uint64_t len) { return kHeader + padded(len); }
+
+// Decode one record at buf[0..len). On success returns bytes consumed and
+// fills *type/*payload_off/*payload_len, advancing *crc_io. Returns 0 when
+// the frame is truncated or the CRC chain breaks (torn tail: caller
+// truncates here, wal/repair.go semantics).
+uint64_t wal_decode(const uint8_t* buf, uint64_t len, uint32_t* crc_io,
+                    uint8_t* type, uint64_t* payload_off, uint64_t* payload_len) {
+  if (len < kHeader) return 0;
+  uint32_t l32;
+  std::memcpy(&l32, buf, 4);
+  uint8_t ty = buf[4];
+  uint32_t crc;
+  std::memcpy(&crc, buf + 5, 4);
+  uint64_t want = kHeader + padded(l32);
+  if (len < want) return 0;
+  uint32_t got = crc32_update(*crc_io, buf + kHeader, l32);
+  if (got != crc) return 0;
+  *crc_io = got;
+  *type = ty;
+  *payload_off = kHeader;
+  *payload_len = l32;
+  return want;
+}
+
+// Batch append: frame n records (concatenated payloads with a length table)
+// into out. Returns total bytes. Used for group-commit batches so one
+// Python->C call frames a whole fsync batch (the reference batches fsyncs
+// per Ready, wal/wal.go MustSync).
+uint64_t wal_encode_batch(const uint8_t* types, const uint64_t* lens,
+                          const uint8_t* payloads, uint64_t n,
+                          uint32_t* crc_io, uint8_t* out) {
+  uint64_t in_off = 0, out_off = 0;
+  for (uint64_t i = 0; i < n; i++) {
+    out_off += wal_encode(types[i], payloads + in_off, lens[i], crc_io, out + out_off);
+    in_off += lens[i];
+  }
+  return out_off;
+}
+
+}  // extern "C"

@@ -1,0 +1,228 @@
+"""Membership changes — analogs of the reference's confchange suite:
+confchange/confchange.go Simple/EnterJoint/LeaveJoint semantics,
+confchange/testdata/{simple_*,joint_*}.txt scenarios, raft.go's
+one-unapplied-change-at-a-time guard (raft.go:1034-1071) and the
+auto-leave rule (raft.go:554-570), plus learner promotion
+(server.go:1341-1474's raft-level substrate).
+"""
+import numpy as np
+
+from etcd_tpu.harness.cluster import Cluster
+from etcd_tpu.models import confchange as cc
+from etcd_tpu.types import (
+    CC_ADD_LEARNER,
+    CC_ADD_NODE,
+    CC_REMOVE_NODE,
+    ROLE_LEADER,
+    Spec,
+)
+
+
+def masks(cl, m, c=0):
+    s = cl.s
+    return (
+        np.asarray(s.voters[c, m]).tolist(),
+        np.asarray(s.voters_out[c, m]).tolist(),
+        np.asarray(s.learners[c, m]).tolist(),
+        np.asarray(s.learners_next[c, m]).tolist(),
+    )
+
+
+def make3of4():
+    """4-slot fleet, members 0-2 voters, slot 3 empty (the joiner)."""
+    cl = Cluster(n_members=4, voters=[True, True, True, False])
+    cl.campaign(0)
+    cl.stabilize()
+    assert cl.leader() == 0
+    return cl
+
+
+def test_simple_add_node():
+    """simple add (confchange.go:130-147): new voter joins, gets the full
+    log, and counts toward quorum."""
+    cl = make3of4()
+    cl.propose_conf_change(0, cc.encode([(CC_ADD_NODE, 3)]))
+    cl.stabilize()
+    for m in range(4):
+        v, vo, l, ln = masks(cl, m)
+        assert v == [True] * 4, (m, v)
+        assert vo == [False] * 4 and l == [False] * 4 and ln == [False] * 4
+    # the joiner caught up and applied everything
+    assert cl.commits().tolist() == [2] * 4
+    cl.propose(0, 77)
+    cl.stabilize()
+    assert cl.commits().tolist() == [3] * 4
+    assert cl.log_entries(3)[-1] == (1, 77)
+
+
+def test_simple_remove_follower():
+    """simple remove: quorum shrinks; remaining pair still commits."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.stabilize()
+    cl.propose_conf_change(0, cc.encode([(CC_REMOVE_NODE, 2)]))
+    cl.stabilize()
+    v, _, _, _ = masks(cl, 0)
+    assert v == [True, True, False]
+    # removed node no longer receives appends; 0+1 alone commit
+    cl.isolate(2)
+    cl.propose(0, 5)
+    cl.stabilize()
+    assert cl.commits().tolist()[:2] == [3, 3]
+
+
+def test_add_learner_then_promote():
+    """learner gets replication but no vote weight; promotion via
+    simple add-node (the raft substrate of PromoteMember)."""
+    cl = make3of4()
+    cl.propose_conf_change(0, cc.encode([(CC_ADD_LEARNER, 3)]))
+    cl.stabilize()
+    v, _, l, _ = masks(cl, 0)
+    assert v == [True, True, True, False]
+    assert l == [False, False, False, True]
+    cl.propose(0, 42)
+    cl.stabilize()
+    # learner replicated + applied but is not a voter
+    assert cl.commits().tolist() == [3] * 4
+    assert cl.log_entries(3)[-1] == (1, 42)
+    # promote
+    cl.propose_conf_change(0, cc.encode([(CC_ADD_NODE, 3)]))
+    cl.stabilize()
+    v, _, l, _ = masks(cl, 0)
+    assert v == [True] * 4 and l == [False] * 4
+
+
+def test_joint_two_changes_auto_leave():
+    """>1 change forces joint consensus with auto-leave
+    (confchange_v2_add_double_auto.txt): outgoing set populated while
+    joint, then an empty cc entry leaves automatically."""
+    cl = Cluster(
+        n_members=5, voters=[True, True, True, False, False], spec=Spec(M=5)
+    )
+    cl.campaign(0)
+    cl.stabilize()
+    cl.propose_conf_change(
+        0, cc.encode([(CC_ADD_NODE, 3), (CC_ADD_NODE, 4)], auto_leave=True)
+    )
+    cl.stabilize()
+    cl.stabilize()  # let the auto-leave entry propagate+commit everywhere
+    for m in range(5):
+        v, vo, l, ln = masks(cl, m)
+        assert v == [True] * 5, (m, v)
+        assert vo == [False] * 5, (m, vo)  # left the joint config
+    cl.propose(0, 9)
+    cl.stabilize()
+    assert min(cl.commits()) == max(cl.commits())
+
+
+def test_joint_demotion_stages_learner_next():
+    """demoting a voter inside a joint config stages it in LearnersNext
+    until LeaveJoint (confchange.go:166-230; joint_learners_next.txt)."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.stabilize()
+    # joint: remove 2 as voter, re-add as learner, no auto-leave
+    cl.propose_conf_change(
+        0,
+        cc.encode(
+            [(CC_ADD_LEARNER, 2), (CC_ADD_NODE, 1)],
+            enter_joint=True,
+            auto_leave=False,
+        ),
+    )
+    cl.stabilize()
+    v, vo, l, ln = masks(cl, 0)
+    assert v == [True, True, False]
+    assert vo == [True, True, True]          # outgoing keeps old voters
+    assert ln == [False, False, True]        # staged, not yet a learner
+    assert l == [False, False, False]
+    # explicit leave
+    cl.propose_conf_change(0, cc.encode_leave_joint())
+    cl.stabilize()
+    v, vo, l, ln = masks(cl, 0)
+    assert v == [True, True, False]
+    assert vo == [False, False, False]
+    assert l == [False, False, True]         # LearnersNext applied
+    assert ln == [False, False, False]
+
+
+def test_joint_quorum_needs_both_majorities():
+    """while joint, commit requires a majority of BOTH incoming and
+    outgoing configs (quorum/joint.go:49-75)."""
+    cl = Cluster(
+        n_members=5, voters=[True, True, True, False, False], spec=Spec(M=5)
+    )
+    cl.campaign(0)
+    cl.stabilize()
+    cl.propose_conf_change(
+        0,
+        cc.encode(
+            [(CC_ADD_NODE, 3), (CC_ADD_NODE, 4)],
+            enter_joint=True,
+            auto_leave=False,
+        ),
+    )
+    cl.stabilize()
+    v, vo, _, _ = masks(cl, 0)
+    assert v == [True] * 5 and vo == [True, True, True, False, False]
+    # cut off the two joiners: old majority {0,1,2} still commits (3/5 new
+    # majority AND 3/3 old majority both satisfied)
+    cl.isolate(3)
+    cl.isolate(4)
+    base = int(cl.commits()[0])
+    cl.propose(0, 1)
+    cl.stabilize()
+    assert int(cl.commits()[0]) == base + 1
+    # now ALSO cut 2: {0,1} is a new-config majority (2 of... no: new config
+    # has 5 voters; {0,1} is not a majority) — nothing commits
+    cl.isolate(2)
+    cl.propose(0, 2)
+    cl.stabilize()
+    assert int(cl.commits()[0]) == base + 1
+
+
+def test_one_unapplied_conf_change_at_a_time():
+    """a second cc proposed while one is pending is demoted to an empty
+    entry (raft.go:1034-1071 pendingConfIndex guard)."""
+    cl = make3of4()
+    cl.isolate(1)  # stall commit progress? no — {0,2} still commit. Instead:
+    cl.recover()
+    # propose two ccs in the same round at the leader: second must be refused
+    cl.propose_conf_change(0, cc.encode([(CC_ADD_NODE, 3)]))
+    cl.propose_conf_change(0, cc.encode([(CC_REMOVE_NODE, 2)]))
+    cl.stabilize()
+    v, _, _, _ = masks(cl, 0)
+    assert v == [True, True, True, True]  # first applied, second blanked
+
+
+def test_remove_leader_self_then_new_election():
+    """leader removing itself: entry commits, then the remaining pair can
+    elect (raft.go removes no special case; promotable() gates re-election)."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.stabilize()
+    cl.propose_conf_change(0, cc.encode([(CC_REMOVE_NODE, 0)]))
+    cl.stabilize()
+    v, _, _, _ = masks(cl, 1)
+    assert v == [False, True, True]
+    cl.campaign(1)
+    cl.stabilize()
+    assert 1 in cl.leaders() or 2 in cl.leaders()
+    cl.propose(1, 3)
+    cl.stabilize()
+    assert int(cl.commits()[1]) >= 3
+
+
+def test_batched_conf_change_divergence():
+    """different clusters in one batch apply different conf changes."""
+    cl = Cluster(n_members=4, C=2, voters=[True, True, True, False])
+    cl.campaign(0, c=0)
+    cl.campaign(0, c=1)
+    cl.stabilize()
+    cl.propose_conf_change(0, cc.encode([(CC_ADD_NODE, 3)]), c=0)
+    cl.propose_conf_change(0, cc.encode([(CC_REMOVE_NODE, 2)]), c=1)
+    cl.stabilize()
+    v0, _, _, _ = masks(cl, 0, c=0)
+    v1, _, _, _ = masks(cl, 0, c=1)
+    assert v0 == [True, True, True, True]
+    assert v1 == [True, True, False, False]

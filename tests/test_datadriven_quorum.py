@@ -1,0 +1,106 @@
+"""Replay the reference's quorum golden files against the TPU kernels.
+
+Source of truth: raft/quorum/testdata/{majority,joint}_{commit,vote}.txt
+driven by raft/quorum/datadriven_test.go. Each case gives configs as voter
+id lists and per-voter acked indexes / votes; the last line of the expected
+block is the committed index (∞ for the empty config) or the VoteResult.
+We map the arbitrary uint64 ids onto dense slots and compare numerically.
+"""
+import re
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from etcd_tpu.harness import datadriven as dd
+from etcd_tpu.ops import quorum
+from etcd_tpu.types import INT32_MAX, VOTE_LOST, VOTE_PENDING, VOTE_WON
+
+pytestmark = pytest.mark.skipif(
+    not dd.reference_available(), reason="reference testdata not mounted"
+)
+
+
+def _cases(fname):
+    if not dd.reference_available():
+        return []
+    return dd.parse_file(dd.testdata("quorum", "testdata", fname))
+
+
+def _slots(args):
+    """Map uint64 ids -> dense slot ids, ordered as (cfg, then new-in-cfgj);
+    returns (ids_order, voters_mask, votersj_mask, joint)."""
+    ids = [int(v) for v in args.get("cfg", [])]
+    joint = "cfgj" in args
+    idsj = [int(v) for v in args.get("cfgj", []) if v != "zero"]
+    order = list(ids)
+    for i in idsj:
+        if i not in order:
+            order.append(i)
+    M = max(len(order), 1)
+    slot = {i: s for s, i in enumerate(order)}
+    v = np.zeros(M, bool)
+    vj = np.zeros(M, bool)
+    for i in ids:
+        v[slot[i]] = True
+    for i in idsj:
+        vj[slot[i]] = True
+    return order, v, vj, joint
+
+
+def _expected_tail(case):
+    last = case.expected[-1].strip() if case.expected else ""
+    return last
+
+
+@pytest.mark.parametrize("fname", ["majority_commit.txt", "joint_commit.txt"])
+def test_committed_index_goldens(fname):
+    cases = _cases(fname)
+    assert cases, fname
+    for case in cases:
+        assert case.cmd == "committed", case.line
+        order, v, vj, joint = _slots(case.args)
+        idx_raw = case.args.get("idx", [])
+        acked = np.zeros(len(order) or 1, np.int32)
+        for pos, val in enumerate(idx_raw):
+            if val != "_":
+                acked[pos] = int(val)
+        got = quorum.joint_committed_index(
+            jnp.asarray(v), jnp.asarray(vj), jnp.asarray(acked)
+        ) if joint else quorum.committed_index(jnp.asarray(v), jnp.asarray(acked))
+        got = int(got)
+        tail = _expected_tail(case)
+        if tail.endswith("∞"):
+            want = INT32_MAX
+        else:
+            m = re.search(r"(\d+)\s*$", tail)
+            assert m, (case.line, tail)
+            want = int(m.group(1))
+        assert got == want, f"{fname}:{case.line}: got {got} want {want}"
+
+
+@pytest.mark.parametrize("fname", ["majority_vote.txt", "joint_vote.txt"])
+def test_vote_result_goldens(fname):
+    cases = _cases(fname)
+    assert cases, fname
+    names = {VOTE_WON: "VoteWon", VOTE_LOST: "VoteLost", VOTE_PENDING: "VotePending"}
+    for case in cases:
+        assert case.cmd == "vote", case.line
+        order, v, vj, joint = _slots(case.args)
+        votes_raw = case.args.get("votes", [])
+        M = len(order) or 1
+        responded = np.zeros(M, bool)
+        granted = np.zeros(M, bool)
+        for pos, val in enumerate(votes_raw):
+            if val == "y":
+                responded[pos] = granted[pos] = True
+            elif val == "n":
+                responded[pos] = True
+        got = quorum.joint_vote_result(
+            jnp.asarray(v), jnp.asarray(vj), jnp.asarray(responded),
+            jnp.asarray(granted),
+        ) if joint else quorum.vote_result(
+            jnp.asarray(v), jnp.asarray(responded), jnp.asarray(granted)
+        )
+        want = _expected_tail(case)
+        assert names[int(got)] == want, f"{fname}:{case.line}"

@@ -1,0 +1,319 @@
+"""Raft paper invariants — transliteration of raft/raft_paper_test.go
+(header at raft_paper_test.go:15-26): each test pins a sentence of the raft
+paper, §5.1-§5.4. Tests drive single nodes with hand-crafted messages via
+Cluster.inject/set_node, the batched analog of r.Step(pb.Message{...}).
+
+Replication-path members of the suite (TestLeaderStartReplication,
+TestLeaderCommitEntry, TestLeaderAcknowledgeCommit,
+TestLeaderCommitPrecedingEntries, TestFollowerCommitEntry,
+TestLeaderSyncFollowerLog, TestLeaderOnlyCommitsLogFromCurrentTerm) live in
+tests/test_replication.py; election-path members overlap tests/
+test_election.py. This file covers the rest.
+"""
+import numpy as np
+import pytest
+
+from etcd_tpu.harness.cluster import Cluster
+from etcd_tpu.types import (
+    MSG_APP,
+    MSG_APP_RESP,
+    MSG_HEARTBEAT,
+    MSG_VOTE,
+    MSG_VOTE_RESP,
+    NONE_ID,
+    ROLE_CANDIDATE,
+    ROLE_FOLLOWER,
+    ROLE_LEADER,
+    Spec,
+)
+
+
+# ---------------------------------------------------------------------------
+# §5.1 terms
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("role", [ROLE_FOLLOWER, ROLE_CANDIDATE, ROLE_LEADER])
+def test_update_term_from_message(role):
+    """TestFollower/Candidate/LeaderUpdateTermFromMessage (§5.1): any node
+    seeing a higher term adopts it and becomes follower."""
+    cl = Cluster(n_members=3)
+    if role == ROLE_CANDIDATE:
+        cl.campaign(0)
+        cl.step()
+        cl.drain()
+    elif role == ROLE_LEADER:
+        cl.campaign(0)
+        cl.stabilize()
+    assert cl.get("role", 0) == role
+    cl.inject(to=0, frm=1, type=MSG_APP, term=5, index=0, log_term=0)
+    cl.step()
+    assert cl.get("term", 0) == 5
+    assert cl.get("role", 0) == ROLE_FOLLOWER
+
+
+def test_reject_stale_term_message(SpecCls=Spec):
+    """TestRejectStaleTermMessage (§5.1): messages with a stale term do not
+    change state."""
+    cl = Cluster(n_members=3)
+    cl.set_node(0, term=2)
+    cl.inject(to=0, frm=1, type=MSG_APP, term=1, index=0, log_term=0)
+    cl.step()
+    assert cl.get("term", 0) == 2
+    assert cl.get("role", 0) == ROLE_FOLLOWER
+    assert cl.get("last_index", 0) == 0
+
+
+def test_start_as_follower():
+    """TestStartAsFollower (§5.2)."""
+    cl = Cluster(n_members=3)
+    assert [cl.get("role", m) for m in range(3)] == [ROLE_FOLLOWER] * 3
+
+
+def test_leader_bcast_beat():
+    """TestLeaderBcastBeat (§5.2): after heartbeat_tick ticks the leader
+    sends MsgHeartbeat to every peer."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.stabilize()
+    assert cl.get("role", 0) == ROLE_LEADER
+    cl.drain()
+    cl.step(tick=True)  # heartbeat_tick defaults to 1
+    hb = [(to, frm) for to, frm, _, t in cl.pending() if t == MSG_HEARTBEAT]
+    assert set(hb) == {(1, 0), (2, 0)}
+
+
+@pytest.mark.parametrize("role", [ROLE_FOLLOWER, ROLE_CANDIDATE])
+def test_nonleader_start_election(role):
+    """TestFollowerStartElection / TestCandidateStartNewElection (§5.2):
+    after election timeout, increment term and send MsgVote to peers."""
+    cl = Cluster(n_members=3)
+    if role == ROLE_CANDIDATE:
+        cl.campaign(0)
+        cl.step()
+        cl.drain()
+    term0 = cl.get("term", 0)
+    # force the timeout to fire deterministically
+    cl.set_node(0, election_elapsed=cl.get("randomized_timeout", 0) - 1)
+    cl.step(tick=True)
+    assert cl.get("term", 0) == term0 + 1
+    assert cl.get("role", 0) == ROLE_CANDIDATE
+    votes = [(to, frm) for to, frm, _, t in cl.pending() if t == MSG_VOTE]
+    assert set(votes) == {(1, 0), (2, 0)}
+
+
+@pytest.mark.parametrize("size,grants,wins", [
+    (1, 0, True), (3, 1, True), (3, 0, False), (5, 2, True), (5, 1, False),
+])
+def test_leader_election_in_one_round_rpc(size, grants, wins):
+    """TestLeaderElectionInOneRoundRPC (§5.2): a candidate wins iff it
+    gathers a majority in the single vote round."""
+    cl = Cluster(n_members=size, spec=Spec(M=size))
+    cl.campaign(0)
+    cl.step()
+    cl.drain()
+    term = cl.get("term", 0)
+    for g in range(grants):
+        cl.inject(to=0, frm=1 + g, type=MSG_VOTE_RESP, term=term, reject=False)
+    cl.step()
+    want = ROLE_LEADER if wins else ROLE_CANDIDATE
+    assert cl.get("role", 0) == want
+
+
+@pytest.mark.parametrize("vote,frm,granted", [
+    (NONE_ID, 1, True), (NONE_ID, 2, True),
+    (1, 1, True), (2, 2, True),
+    (1, 2, False), (2, 1, False),
+])
+def test_follower_vote(vote, frm, granted):
+    """TestFollowerVote (§5.2): grant iff no vote yet this term or already
+    voted for the requester."""
+    cl = Cluster(n_members=3)
+    cl.set_node(0, term=1, vote=vote)
+    cl.inject(to=0, frm=frm, type=MSG_VOTE, term=1, index=0, log_term=0)
+    cl.step()
+    resp = [
+        (to, f) for to, f, _, t in cl.pending() if t == MSG_VOTE_RESP
+    ]
+    assert resp == [(frm, 0)]
+    assert bool(cl.msg_field("reject", to=frm, frm=0)) == (not granted)
+
+
+@pytest.mark.parametrize("dterm", [0, 1])
+def test_candidate_fallback(dterm):
+    """TestCandidateFallback (§5.2): a candidate hearing MsgApp at >= its
+    term reverts to follower."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.step()
+    cl.drain()
+    term = cl.get("term", 0)
+    cl.inject(to=0, frm=2, type=MSG_APP, term=term + dterm, index=0, log_term=0)
+    cl.step()
+    assert cl.get("role", 0) == ROLE_FOLLOWER
+    assert cl.get("term", 0) == term + dterm
+    assert cl.get("lead", 0) == 2
+
+
+def test_election_timeout_randomized():
+    """TestFollower/CandidateElectionTimeoutRandomized (§5.2): timeouts are
+    drawn from [T, 2T) and vary across nodes/redraws."""
+    et = 10
+    cl = Cluster(n_members=5, C=16, spec=Spec(M=5))
+    seen = set()
+    for c in range(16):
+        for m in range(5):
+            to = cl.get("randomized_timeout", m, c=c)
+            assert et <= to < 2 * et
+            seen.add(to)
+    assert len(seen) >= et // 2  # spread, not constant
+
+
+def test_election_timeouts_mostly_nonconflicting():
+    """TestFollowersElectionTimeoutNonconflict flavor: the randomized draw
+    keeps simultaneous campaigns rare (conflict rate well under 50%)."""
+    C = 16
+    cl = Cluster(n_members=5, C=C, spec=Spec(M=5))
+    conflicts = 0
+    for c in range(C):
+        tos = [cl.get("randomized_timeout", m, c=c) for m in range(5)]
+        if min(tos) == sorted(tos)[1]:
+            conflicts += 1
+    assert conflicts / C < 0.5
+
+
+# ---------------------------------------------------------------------------
+# §5.3 / §5.4 log matching & vote safety (message-level)
+# ---------------------------------------------------------------------------
+
+def test_vote_request_carries_log_position():
+    """TestVoteRequest (§5.4.1): MsgVote carries the candidate's lastIndex
+    and lastLogTerm."""
+    cl = Cluster(n_members=3)
+    cl.inject(
+        to=0, frm=1, type=MSG_APP, term=2, index=0, log_term=0,
+        ent_len=1, ent_term=[2, 0, 0, 0], ent_data=[9, 0, 0, 0],
+        ent_type=[0, 0, 0, 0],
+    )
+    cl.step()
+    cl.drain()
+    assert cl.get("last_index", 0) == 1
+    cl.campaign(0)
+    cl.step()
+    votes = [(to, f) for to, f, _, t in cl.pending() if t == MSG_VOTE]
+    assert set(votes) == {(1, 0), (2, 0)}
+    for to, _ in votes:
+        assert cl.msg_field("index", to=to, frm=0) == 1
+        assert cl.msg_field("log_term", to=to, frm=0) == 2
+
+
+@pytest.mark.parametrize("my_lt,my_li,cand_lt,cand_li,reject", [
+    # candidate log more up-to-date -> grant
+    (1, 1, 2, 1, False), (1, 1, 2, 2, False), (1, 1, 1, 2, False),
+    # equal -> grant
+    (1, 1, 1, 1, False),
+    # voter more up-to-date -> reject
+    (2, 1, 1, 1, True), (2, 1, 1, 2, True), (1, 2, 1, 1, True),
+])
+def test_voter_up_to_date_check(my_lt, my_li, cand_lt, cand_li, reject):
+    """TestVoter (§5.4.1): grant only to candidates whose log is at least as
+    up-to-date (raftLog.isUpToDate, log.go:313)."""
+    cl = Cluster(n_members=2, spec=Spec(M=2))
+    ents_t = [0, 0, 0, 0]
+    for i in range(my_li):
+        ents_t[i] = my_lt if i == my_li - 1 else 1
+    cl.inject(
+        to=0, frm=1, type=MSG_APP, term=my_lt, index=0, log_term=0,
+        ent_len=my_li, ent_term=ents_t, ent_data=[0, 0, 0, 0],
+        ent_type=[0, 0, 0, 0],
+    )
+    cl.step()
+    cl.drain()
+    assert cl.get("last_index", 0) == my_li
+    cl.inject(
+        to=0, frm=1, type=MSG_VOTE, term=max(my_lt, cand_lt) + 1,
+        index=cand_li, log_term=cand_lt,
+    )
+    cl.step()
+    assert bool(cl.msg_field("reject", to=1, frm=0)) == reject
+
+
+def test_follower_check_msg_app():
+    """TestFollowerCheckMsgApp (§5.3): a follower rejects MsgApp whose
+    prev(index,term) doesn't match its log, with a hint."""
+    cl = Cluster(n_members=3)
+    cl.campaign(0)
+    cl.stabilize()
+    cl.propose(0, 1)
+    cl.stabilize()
+    # follower 1 has [empty@t1, 1@t1]; MsgApp claiming prev=(5, t1) -> reject
+    cl.inject(to=1, frm=0, type=MSG_APP, term=1, index=5, log_term=1)
+    cl.step()
+    resps = [
+        (to, f) for to, f, _, t in cl.pending() if t == MSG_APP_RESP and to == 0
+    ]
+    assert (0, 1) in resps
+    assert bool(cl.msg_field("reject", to=0, frm=1))
+    assert cl.msg_field("reject_hint", to=0, frm=1) == 2  # its lastIndex
+
+
+def test_follower_append_entries_overwrites_conflict():
+    """TestFollowerAppendEntries (§5.3): conflicting suffix is deleted and
+    the leader's entries appended."""
+    cl = Cluster(n_members=2, spec=Spec(M=2))
+    # build local log [t1, t2] via two appends from a fake leader
+    cl.inject(
+        to=0, frm=1, type=MSG_APP, term=2, index=0, log_term=0,
+        ent_len=2, ent_term=[1, 2, 0, 0], ent_data=[10, 20, 0, 0],
+        ent_type=[0, 0, 0, 0],
+    )
+    cl.step()
+    cl.drain()
+    assert cl.log_entries(0) == [(1, 10), (2, 20)]
+    # conflicting append at index 2 with term 3
+    cl.inject(
+        to=0, frm=1, type=MSG_APP, term=3, index=1, log_term=1,
+        ent_len=1, ent_term=[3, 0, 0, 0], ent_data=[30, 0, 0, 0],
+        ent_type=[0, 0, 0, 0],
+    )
+    cl.step()
+    assert cl.log_entries(0) == [(1, 10), (3, 30)]
+
+
+def test_leader_acknowledge_commit():
+    """TestLeaderAcknowledgeCommit (§5.3): the entry commits once a quorum
+    of followers acked it; lone leader commits immediately."""
+    for size, acks, committed in [(1, 0, True), (3, 0, False), (3, 1, True),
+                                  (5, 1, False), (5, 2, True)]:
+        cl = Cluster(n_members=size, spec=Spec(M=size))
+        cl.campaign(0)
+        cl.stabilize()
+        base = cl.get("commit", 0)
+        cl.drain()
+        cl.propose(0, 3)
+        cl.step()
+        cl.drain()  # swallow the MsgApps: no real follower acks
+        term = cl.get("term", 0)
+        li = cl.get("last_index", 0)
+        for a in range(acks):
+            cl.inject(
+                to=0, frm=1 + a, type=MSG_APP_RESP, term=term, index=li,
+                reject=False,
+            )
+        cl.step()
+        got = cl.get("commit", 0) >= base + 1
+        assert got == committed, (size, acks)
+
+
+def test_follower_commit_entry():
+    """TestFollowerCommitEntry (§5.3): a follower commits (and applies) at
+    the leader's commit index."""
+    cl = Cluster(n_members=3)
+    cl.inject(
+        to=0, frm=1, type=MSG_APP, term=1, index=0, log_term=0,
+        ent_len=1, ent_term=[1, 0, 0, 0], ent_data=[77, 0, 0, 0],
+        ent_type=[0, 0, 0, 0], commit=1,
+    )
+    cl.step()
+    assert cl.get("commit", 0) == 1
+    assert cl.get("applied", 0) == 1
+    assert cl.log_entries(0) == [(1, 77)]
