@@ -4,10 +4,17 @@ The reference runs one goroutine per node and moves messages through
 rafthttp streams (server/etcdserver/api/rafthttp/). Here a fleet of
 ``C x M`` nodes steps in lockstep: ``jax.vmap`` over members then clusters
 turns the per-node round into one fused XLA program, and the "network" is a
-transpose of the dense outbox tensor ``[C, from, to, K] -> [C, to, from, K]``
+transpose of the dense outbox tensor ``[from, to, K, C] -> [to, from, K, C]``
 with a multiplicative keep-mask standing in for drop/partition faults
 (rafttest/network.go:33-64's drop/disconnect semantics; dropping is legal
 per the transport contract, etcdserver/raft.go:107-110).
+
+Fleet layout: **clusters-minor** — every leaf is ``[M, feature..., C]``
+with the huge batch axis LAST. TPU tiles the two minor dims to (8, 128)
+sublanes x lanes; with clusters leading, a ``[C, 5, 5]`` leaf pads 41x and
+the fleet OOMs at scale, while clusters-minor pads only the tiny member
+axis (<=1.6x). The member axes stay leading and fully on-device, which is
+where the per-round message transpose happens.
 """
 from __future__ import annotations
 
@@ -24,12 +31,12 @@ from etcd_tpu.utils.config import RaftConfig
 
 
 def empty_inbox(spec: Spec, C: int) -> Msg:
-    """Zeroed inbox [C, to, from, K]."""
+    """Zeroed inbox [to, from, K, (E,) C]."""
     from etcd_tpu.types import empty_msg
 
     return jax.tree.map(
         lambda x: jnp.broadcast_to(
-            x, (C, spec.M, spec.M, spec.K) + x.shape
+            x[..., None], (spec.M, spec.M, spec.K) + x.shape + (C,)
         ),
         empty_msg(spec),
     )
@@ -60,21 +67,26 @@ def init_fleet(
             election_tick=election_tick,
         )
 
+    # members leading (axis 0), clusters minor (axis -1)
     return jax.vmap(
-        lambda c: jax.vmap(lambda m: one(c, m))(jnp.arange(spec.M, dtype=jnp.int32))
-    )(jnp.arange(C, dtype=jnp.int32))
+        lambda m: jax.vmap(lambda c: one(c, m), out_axes=-1)(
+            jnp.arange(C, dtype=jnp.int32)
+        )
+    )(jnp.arange(spec.M, dtype=jnp.int32))
 
 
 def build_round(cfg: RaftConfig, spec: Spec):
     """Returns round_fn(state, inbox, prop_len, prop_data, prop_type,
     ri_ctx, do_hup, do_tick, keep_mask) -> (state, next_inbox).
 
-    Shapes: state/* leaves [C, M, ...]; inbox leaves [C, M, M, K, ...];
-    prop_len/ri_ctx/do_hup/do_tick [C, M]; prop_data/prop_type [C, M, E];
-    keep_mask [C, M(from), M(to)] bool (True = deliver).
+    Shapes (clusters-minor): state/* leaves [M, ..., C]; inbox leaves
+    [M(to), M(from), K, (E,) C]; prop_len/ri_ctx/do_hup/do_tick [M, C];
+    prop_data/prop_type [M, E, C]; keep_mask [M(from), M(to), C] bool
+    (True = deliver).
     """
     node_fn = functools.partial(node_round, cfg, spec)
-    vmapped = jax.vmap(jax.vmap(node_fn))
+    # outer vmap: member axis (leading); inner vmap: cluster axis (minor)
+    vmapped = jax.vmap(jax.vmap(node_fn, in_axes=-1, out_axes=-1))
 
     def round_fn(
         state: NodeState,
@@ -90,11 +102,11 @@ def build_round(cfg: RaftConfig, spec: Spec):
         state, ob = vmapped(
             state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup, do_tick
         )
-        msgs = ob.msgs  # leaves [C, from, to, K, ...]
+        msgs = ob.msgs  # leaves [from, to, K, (E,) C]
         # self-loops (MsgHup-to-self etc.) are local, never subject to faults
-        keep = keep_mask | jnp.eye(spec.M, dtype=jnp.bool_)[None]
-        msgs = msgs.replace(type=jnp.where(keep[..., None], msgs.type, 0))
-        next_inbox = jax.tree.map(lambda x: jnp.swapaxes(x, 1, 2), msgs)
+        keep = keep_mask | jnp.eye(spec.M, dtype=jnp.bool_)[:, :, None]
+        msgs = msgs.replace(type=jnp.where(keep[:, :, None, :], msgs.type, 0))
+        next_inbox = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), msgs)
         return state, next_inbox
 
     return round_fn
@@ -117,7 +129,7 @@ class RaftEngine:
             spec, C, voters, learners, seed, election_tick=cfg.election_tick
         )
         self.inbox = empty_inbox(spec, C)
-        self.keep_mask = jnp.ones((C, spec.M, spec.M), jnp.bool_)
+        self.keep_mask = jnp.ones((spec.M, spec.M, C), jnp.bool_)
         self._round = jax.jit(build_round(cfg, spec))
 
     # -- one lockstep round -------------------------------------------------
