@@ -6,6 +6,11 @@ drop/cut/isolate/recover) and of the rafttest InteractionEnv verbs
 (campaign/propose/stabilize, raft/rafttest/interaction_env_handler.go).
 All C clusters advance in lockstep; the per-link fault state is the
 engine's keep-mask.
+
+Layout note: the fleet is clusters-minor — every state leaf is
+``[M, feature..., C]``, inbox leaves ``[to, from, K, (E,) C]``, the
+keep-mask ``[from, to, C]``. Host-side accessors below take (m, c) and
+index ``leaf[m, ..., c]``.
 """
 from __future__ import annotations
 
@@ -41,49 +46,49 @@ class Cluster:
     # -- queued inputs applied on the next round ----------------------------
     def _reset_inputs(self):
         C, M, E = self.C, self.spec.M, self.spec.E
-        self._hup = np.zeros((C, M), bool)
-        self._plen = np.zeros((C, M), np.int32)
-        self._pdata = np.zeros((C, M, E), np.int32)
-        self._ptype = np.zeros((C, M, E), np.int32)
-        self._rictx = np.zeros((C, M), np.int32)
+        self._hup = np.zeros((M, C), bool)
+        self._plen = np.zeros((M, C), np.int32)
+        self._pdata = np.zeros((M, E, C), np.int32)
+        self._ptype = np.zeros((M, E, C), np.int32)
+        self._rictx = np.zeros((M, C), np.int32)
 
     def campaign(self, m: int, c: int = 0):
-        self._hup[c, m] = True
+        self._hup[m, c] = True
 
     def propose(self, m: int, data: int, c: int = 0):
         """Queue one normal-entry proposal at node m."""
-        i = int(self._plen[c, m])
+        i = int(self._plen[m, c])
         if i >= self.spec.E:
             raise ValueError("proposal batch full for this round")
-        self._pdata[c, m, i] = data
-        self._ptype[c, m, i] = ENTRY_NORMAL
-        self._plen[c, m] = i + 1
+        self._pdata[m, i, c] = data
+        self._ptype[m, i, c] = ENTRY_NORMAL
+        self._plen[m, c] = i + 1
 
     def propose_conf_change(self, m: int, data: int, c: int = 0):
-        i = int(self._plen[c, m])
-        self._pdata[c, m, i] = data
-        self._ptype[c, m, i] = ENTRY_CONF_CHANGE
-        self._plen[c, m] = i + 1
+        i = int(self._plen[m, c])
+        self._pdata[m, i, c] = data
+        self._ptype[m, i, c] = ENTRY_CONF_CHANGE
+        self._plen[m, c] = i + 1
 
     def read_index(self, m: int, c: int = 0) -> int:
         ctx = self._next_ctx
         self._next_ctx += 1
-        self._rictx[c, m] = ctx
+        self._rictx[m, c] = ctx
         return ctx
 
     # -- faults (raft_test.go:4722-4748) ------------------------------------
     def isolate(self, m: int, c: int | None = None):
         km = np.array(self.eng.keep_mask)
         cs = slice(None) if c is None else c
-        km[cs, m, :] = False
-        km[cs, :, m] = False
+        km[m, :, cs] = False
+        km[:, m, cs] = False
         self.eng.keep_mask = jnp.asarray(km)
 
     def cut(self, a: int, b: int, c: int | None = None):
         km = np.array(self.eng.keep_mask)
         cs = slice(None) if c is None else c
-        km[cs, a, b] = False
-        km[cs, b, a] = False
+        km[a, b, cs] = False
+        km[b, a, cs] = False
         self.eng.keep_mask = jnp.asarray(km)
 
     def partition(self, groups: list[list[int]], c: int | None = None):
@@ -96,13 +101,13 @@ class Cluster:
                     km[a, b] = True
         full = np.array(self.eng.keep_mask)
         cs = slice(None) if c is None else c
-        full[cs] = km
+        full[:, :, cs] = km[:, :, None] if c is None else km
         self.eng.keep_mask = jnp.asarray(full)
 
     def recover(self, c: int | None = None):
         km = np.array(self.eng.keep_mask)
         cs = slice(None) if c is None else c
-        km[cs] = True
+        km[:, :, cs] = True
         self.eng.keep_mask = jnp.asarray(km)
 
     # -- stepping ------------------------------------------------------------
@@ -141,13 +146,17 @@ class Cluster:
         upd = {}
         for k, v in fields.items():
             leaf = np.array(getattr(st, k))
-            leaf[c, m] = v
+            leaf[m, ..., c] = v
             upd[k] = jnp.asarray(leaf)
         self.eng.state = st.replace(**upd)
 
     def get(self, field: str, m: int, c: int = 0):
-        v = np.asarray(getattr(self.eng.state, field)[c, m])
+        v = np.asarray(getattr(self.eng.state, field)[m, ..., c])
         return v.item() if v.ndim == 0 else v
+
+    def leaf(self, field: str, c: int = 0) -> np.ndarray:
+        """One cluster's view of a state leaf, members leading: [M, ...]."""
+        return np.asarray(getattr(self.eng.state, field)[..., c])
 
     def inject(self, to: int, frm: int, c: int = 0, slot: int = 0, **fields):
         """Place a raw message into the pending inbox (delivered next step)."""
@@ -156,7 +165,7 @@ class Cluster:
         fields.setdefault("frm", frm)
         for k, v in fields.items():
             leaf = np.array(getattr(ib, k))
-            leaf[c, to, frm, slot] = v
+            leaf[to, frm, slot, ..., c] = v
             upd[k] = jnp.asarray(leaf)
         self.eng.inbox = ib.replace(**upd)
 
@@ -165,19 +174,19 @@ class Cluster:
         move, raft_test.go:4750-4760)."""
         ib = self.eng.inbox
         t = np.array(ib.type)
-        t[c] = 0
+        t[..., c] = 0
         self.eng.inbox = ib.replace(type=jnp.asarray(t))
 
     def pending(self, c: int = 0):
         """[(to, frm, slot, type), ...] of undelivered messages."""
-        t = np.asarray(self.eng.inbox.type[c])
+        t = np.asarray(self.eng.inbox.type[..., c])
         out = []
         for to, frm, k in zip(*np.nonzero(t)):
             out.append((int(to), int(frm), int(k), int(t[to, frm, k])))
         return out
 
     def msg_field(self, field: str, to: int, frm: int, slot: int = 0, c: int = 0):
-        v = np.asarray(getattr(self.eng.inbox, field)[c, to, frm, slot])
+        v = np.asarray(getattr(self.eng.inbox, field)[to, frm, slot, ..., c])
         return v.item() if v.ndim == 0 else v
 
     # -- inspection ----------------------------------------------------------
@@ -189,10 +198,10 @@ class Cluster:
         return np.asarray(leaf)
 
     def roles(self, c: int = 0) -> np.ndarray:
-        return np.asarray(self.s.role[c])
+        return self.leaf("role", c)
 
     def leaders(self, c: int = 0) -> list[int]:
-        lead = np.asarray(self.s.role[c]) == ROLE_LEADER
+        lead = self.roles(c) == ROLE_LEADER
         return [int(i) for i in np.nonzero(lead)[0]]
 
     def leader(self, c: int = 0) -> int:
@@ -201,22 +210,22 @@ class Cluster:
         ids = self.leaders(c)
         if not ids:
             return NONE_ID
-        terms = np.asarray(self.s.term[c])
+        terms = self.terms(c)
         return int(max(ids, key=lambda i: terms[i]))
 
     def terms(self, c: int = 0) -> np.ndarray:
-        return np.asarray(self.s.term[c])
+        return self.leaf("term", c)
 
     def commits(self, c: int = 0) -> np.ndarray:
-        return np.asarray(self.s.commit[c])
+        return self.leaf("commit", c)
 
     def log_entries(self, m: int, c: int = 0) -> list[tuple[int, int]]:
         """[(term, data), ...] for indexes (snap, last]."""
         s = self.s
-        last = int(s.last_index[c, m])
-        snap = int(s.snap_index[c, m])
-        lt = np.asarray(s.log_term[c, m])
-        ld = np.asarray(s.log_data[c, m])
+        last = int(s.last_index[m, c])
+        snap = int(s.snap_index[m, c])
+        lt = np.asarray(s.log_term[m, ..., c])
+        ld = np.asarray(s.log_data[m, ..., c])
         out = []
         for i in range(snap + 1, last + 1):
             sl = (i - 1) % self.spec.L

@@ -142,27 +142,30 @@ class RaftEngine:
         do_hup=None,
         do_tick=False,
     ):
+        """All inputs use the device (clusters-minor) layout:
+        prop_len/ri_ctx/do_hup/do_tick [M, C]; prop_data/prop_type
+        [M, E, C]."""
         C, M, E = self.C, self.spec.M, self.spec.E
-        z2 = jnp.zeros((C, M), jnp.int32)
+        z2 = jnp.zeros((M, C), jnp.int32)
         prop_len = z2 if prop_len is None else jnp.asarray(prop_len, jnp.int32)
         prop_data = (
-            jnp.zeros((C, M, E), jnp.int32)
+            jnp.zeros((M, E, C), jnp.int32)
             if prop_data is None
             else jnp.asarray(prop_data, jnp.int32)
         )
         prop_type = (
-            jnp.zeros((C, M, E), jnp.int32)
+            jnp.zeros((M, E, C), jnp.int32)
             if prop_type is None
             else jnp.asarray(prop_type, jnp.int32)
         )
         ri_ctx = z2 if ri_ctx is None else jnp.asarray(ri_ctx, jnp.int32)
         do_hup = (
-            jnp.zeros((C, M), jnp.bool_)
+            jnp.zeros((M, C), jnp.bool_)
             if do_hup is None
             else jnp.asarray(do_hup, jnp.bool_)
         )
         if isinstance(do_tick, bool):
-            do_tick = jnp.full((C, M), do_tick, jnp.bool_)
+            do_tick = jnp.full((M, C), do_tick, jnp.bool_)
         else:
             do_tick = jnp.asarray(do_tick, jnp.bool_)
         self.state, self.inbox = self._round(

@@ -2,7 +2,7 @@
 
 The reference scales by running more processes connected over rafthttp
 (server/etcdserver/api/rafthttp/) — its NCCL/MPI analog. The TPU-native
-equivalent shards the *clusters* axis of the ``[C, M]`` fleet over a
+equivalent shards the *clusters* axis of the fleet over a
 ``jax.sharding.Mesh``: every cluster's message exchange is a within-cluster
 transpose (member axis stays on-device), so the clusters axis is purely
 data-parallel and XLA places one shard per device with zero collectives in
@@ -10,24 +10,27 @@ the steady state — the ICI/DCN budget is spent only by the host driver
 (proposal feed / applied drain), mirroring rafthttp's "client traffic at the
 edge, peer traffic inside" split.
 
-Two entry points:
-  * :func:`build_sharded_round` — jit of the fused round with
-    ``NamedSharding`` constraints on the clusters axis (lets XLA do the
-    placement; the program is identical to the single-device one).
+Layout: the fleet is **clusters-minor** — the huge C axis is the LAST axis
+of every leaf (state ``[M, ..., C]``, inbox ``[to, from, K, (E,) C]``,
+keep-mask ``[from, to, C]``) so TPU (8,128) tiling pads only the tiny
+member axes. The mesh therefore shards the *last* axis of every leaf.
+
+Entry points:
+  * :func:`build_sharded_round` — jit of the fused round with per-leaf
+    ``NamedSharding`` constraints on the trailing clusters axis.
   * :func:`build_shard_map_round` — explicit ``shard_map`` over the clusters
     axis, the form that composes with cross-shard collectives (e.g. global
     invariant checks via ``psum``) and with a second DCN mesh axis.
+  * :func:`build_scan_rounds` — on-device lax.scan of many rounds.
 """
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from etcd_tpu.models.engine import build_round
+from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
 from etcd_tpu.types import Spec
 from etcd_tpu.utils.config import RaftConfig
 
@@ -46,41 +49,57 @@ def make_fleet_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (CLUSTER_AXIS,))
 
 
-def _c_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(CLUSTER_AXIS))
+def _last_axis_p(x) -> P:
+    """PartitionSpec sharding the trailing (clusters) axis of one leaf."""
+    return P(*([None] * (x.ndim - 1)), CLUSTER_AXIS)
+
+
+def _leaf_sharding(mesh: Mesh, x) -> NamedSharding:
+    return NamedSharding(mesh, _last_axis_p(x))
 
 
 def shard_fleet(mesh: Mesh, *trees):
-    """Place every leaf of each pytree with its leading C axis split over the
-    mesh. Returns the trees device-put with NamedSharding."""
-    sh = _c_sharding(mesh)
+    """Place every leaf of each pytree with its trailing C axis split over
+    the mesh. Returns the trees device-put with NamedSharding."""
 
     def put(x):
-        return jax.device_put(x, sh)
+        return jax.device_put(x, _leaf_sharding(mesh, x))
 
     out = tuple(jax.tree.map(put, t) for t in trees)
     return out[0] if len(out) == 1 else out
+
+
+def _constrain(mesh: Mesh, tree):
+    return jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(x, _leaf_sharding(mesh, x)),
+        tree,
+    )
+
+
+def fleet_in_specs(cfg: RaftConfig, spec: Spec):
+    """Per-leaf PartitionSpecs (trailing axis on the mesh) for the 9 round
+    args: (state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup,
+    do_tick, keep_mask). Computed abstractly — no arrays materialised."""
+    st = jax.eval_shape(
+        lambda: init_fleet(spec, 2, election_tick=cfg.election_tick)
+    )
+    ib = jax.eval_shape(lambda: empty_inbox(spec, 2))
+    state_specs = jax.tree.map(_last_axis_p, st)
+    inbox_specs = jax.tree.map(_last_axis_p, ib)
+    v2 = P(None, CLUSTER_AXIS)
+    v3 = P(None, None, CLUSTER_AXIS)
+    return (state_specs, inbox_specs, v2, v3, v3, v2, v2, v2, v3)
 
 
 def build_sharded_round(cfg: RaftConfig, spec: Spec, mesh: Mesh):
     """Jitted round with all inputs/outputs constrained to the clusters
     sharding. Identical math to engine.build_round; placement only."""
     round_fn = build_round(cfg, spec)
-    sh = _c_sharding(mesh)
 
     def constrained(*args):
-        args = tuple(
-            jax.tree.map(lambda x: jax.lax.with_sharding_constraint(x, sh), a)
-            for a in args
-        )
+        args = tuple(_constrain(mesh, a) for a in args)
         state, inbox = round_fn(*args)
-        state = jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(x, sh), state
-        )
-        inbox = jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(x, sh), inbox
-        )
-        return state, inbox
+        return _constrain(mesh, state), _constrain(mesh, inbox)
 
     return jax.jit(constrained)
 
@@ -90,14 +109,13 @@ def build_shard_map_round(cfg: RaftConfig, spec: Spec, mesh: Mesh):
     locally. Composes with cross-shard collectives (psum of invariant
     violations etc.) and nested member-axis sharding later."""
     round_fn = build_round(cfg, spec)
-    pspec = P(CLUSTER_AXIS)
-    n_args = 9  # state, inbox, prop_len, prop_data, prop_type, ri_ctx, hup, tick, keep
+    in_specs = fleet_in_specs(cfg, spec)
 
     fn = shard_map(
         round_fn,
         mesh=mesh,
-        in_specs=(pspec,) * n_args,
-        out_specs=(pspec, pspec),
+        in_specs=in_specs,
+        out_specs=(in_specs[0], in_specs[1]),
         check_rep=False,
     )
     return jax.jit(fn)
@@ -133,22 +151,18 @@ def build_scan_rounds(cfg: RaftConfig, spec: Spec, mesh: Mesh | None, rounds: in
     if mesh is None:
         return jax.jit(many)
     if use_shard_map:
-        pspec = P(CLUSTER_AXIS)
+        in_specs = fleet_in_specs(cfg, spec)
         fn = shard_map(
             many,
             mesh=mesh,
-            in_specs=(pspec,) * 9,
-            out_specs=(pspec, pspec),
+            in_specs=in_specs,
+            out_specs=(in_specs[0], in_specs[1]),
             check_rep=False,
         )
         return jax.jit(fn)
-    sh = _c_sharding(mesh)
 
     def constrained(*args):
-        args = tuple(
-            jax.tree.map(lambda x: jax.lax.with_sharding_constraint(x, sh), a)
-            for a in args
-        )
+        args = tuple(_constrain(mesh, a) for a in args)
         return many(*args)
 
     return jax.jit(constrained)

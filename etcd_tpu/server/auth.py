@@ -139,12 +139,21 @@ class AuthStore:
         self._bump()
 
     # -- users ---------------------------------------------------------------
-    def user_add(self, name: str, password: str = "", no_password: bool = False):
+    def user_add(self, name: str, password: str = "", no_password: bool = False,
+                 salt: bytes | None = None, pw_hash: bytes | None = None):
+        """Apply-path user creation. For replicated applies the proposer
+        hashes the password once and ships (salt, pw_hash) inside the entry
+        — matching auth/store.go, which stores the bcrypt hash carried by
+        the AuthUserAdd request — so every member (and every deterministic
+        replay) produces identical auth state."""
         if name in self.users:
             raise ErrUserAlreadyExist(name)
-        salt = os.urandom(16)
+        if salt is None:
+            salt = os.urandom(16)
+        if pw_hash is None:
+            pw_hash = b"" if no_password else _hash(password, salt)
         self.users[name] = User(
-            name, salt, b"" if no_password else _hash(password, salt),
+            name, salt, b"" if no_password else pw_hash,
             no_password=no_password,
         )
         self._bump()
@@ -160,12 +169,16 @@ class AuthStore:
         }
         self._bump()
 
-    def user_change_password(self, name: str, password: str):
+    def user_change_password(self, name: str, password: str = "",
+                             salt: bytes | None = None,
+                             pw_hash: bytes | None = None):
+        """See user_add: (salt, pw_hash) are precomputed by the proposer for
+        deterministic replicated applies."""
         u = self.users.get(name)
         if u is None:
             raise ErrUserNotFound(name)
-        u.salt = os.urandom(16)
-        u.pw_hash = _hash(password, u.salt)
+        u.salt = salt if salt is not None else os.urandom(16)
+        u.pw_hash = pw_hash if pw_hash is not None else _hash(password, u.salt)
         self._bump()
 
     def user_grant_role(self, name: str, role: str):
@@ -219,6 +232,44 @@ class AuthStore:
             p for p in r.perms if (p.key, p.range_end) != (key, range_end)
         ]
         self._bump()
+
+    # -- snapshot/restore (the authBuckets content in schema/auth.go) --------
+    def to_snapshot(self) -> dict:
+        """Replicated auth state only — tokens are node-local and ephemeral
+        (the reference's simple tokens live in memory, not the backend)."""
+        return {
+            "enabled": self.enabled,
+            "revision": self.revision,
+            "users": {
+                n: {
+                    "salt": u.salt,
+                    "pw_hash": u.pw_hash,
+                    "roles": sorted(u.roles),
+                    "no_password": u.no_password,
+                }
+                for n, u in self.users.items()
+            },
+            "roles": {
+                n: [
+                    (p.perm_type, p.key, p.range_end) for p in r.perms
+                ]
+                for n, r in self.roles.items()
+            },
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.enabled = snap["enabled"]
+        self.revision = snap["revision"]
+        self.users = {
+            n: User(n, d["salt"], d["pw_hash"], set(d["roles"]),
+                    d["no_password"])
+            for n, d in snap["users"].items()
+        }
+        self.roles = {
+            n: Role(n, [Permission(t, k, re) for t, k, re in perms])
+            for n, perms in snap["roles"].items()
+        }
+        self.tokens.clear()
 
     # -- authn (simple token provider) ---------------------------------------
     def authenticate(self, name: str, password: str) -> str:

@@ -172,28 +172,90 @@ class EtcdCluster:
         (the applyAll path, server.go:903-1104)."""
         s = self.cl.s
         c = self.c
-        applied = np.asarray(s.applied[c])
-        last = np.asarray(s.last_index[c])
-        snap = np.asarray(s.snap_index[c])
-        terms = np.asarray(s.log_term[c])
-        datas = np.asarray(s.log_data[c])
-        types = np.asarray(s.log_type[c])
+        applied = np.asarray(s.applied[..., c])
+        last = np.asarray(s.last_index[..., c])
+        snap = np.asarray(s.snap_index[..., c])
+        terms = np.asarray(s.log_term[..., c])
+        datas = np.asarray(s.log_data[..., c])
+        types = np.asarray(s.log_type[..., c])
         L = self.cl.spec.L
-        for m, ms in enumerate(self.members):
-            hi = int(applied[m])
-            lo = ms.applied_index
-            if hi <= lo:
-                continue
-            # entries still on the ring? (host fell behind a device snapshot)
-            start = max(lo + 1, int(snap[m]) + 1)
-            for idx in range(start, hi + 1):
+
+        def apply_range(m, ms, lo, hi):
+            for idx in range(lo + 1, hi + 1):
                 sl = (idx - 1) % L
                 self._apply_entry(
                     m, ms, idx, int(types[m, sl]), int(datas[m, sl]),
                     int(terms[m, sl]),
                 )
             ms.applied_index = hi
+
+        # pass 1: members whose ring still covers their gap — pumping them
+        # first means their fresh host state is available as snapshot donor
+        # material for pass 2
+        gapped = []
+        for m, ms in enumerate(self.members):
+            hi, lo = int(applied[m]), ms.applied_index
+            if hi <= lo:
+                continue
+            if int(snap[m]) > lo:
+                gapped.append(m)
+                continue
+            apply_range(m, ms, lo, hi)
+        # pass 2: the device compacted past these members' host-applied
+        # cursors — entries (lo, snap] are gone from the ring. Install a
+        # peer's state-machine snapshot first (the applySnapshot path,
+        # server.go:925-1061); silently skipping the gap would diverge this
+        # member's MVCC from its peers.
+        for m in gapped:
+            ms = self.members[m]
+            self._install_peer_snapshot(m, ms, int(snap[m]))
+            hi, lo = int(applied[m]), ms.applied_index
+            if hi > lo:
+                apply_range(m, ms, lo, hi)
         self._gc_requests()
+
+    def _install_peer_snapshot(self, m: int, ms: "MemberState",
+                               need: int) -> None:
+        """Restore member m's applied state machine from the most advanced
+        peer whose snapshot covers index `need` (SendSnapshot/applySnapshot:
+        rafthttp snapshot_sender.go + server.go:925). Raises ErrCorrupt if
+        no peer can cover the gap — failing loudly beats silent divergence."""
+        donors = [
+            d for d in range(self.M)
+            if d != m and self.members[d].applied_index >= need
+        ]
+        if not donors:
+            raise ErrCorrupt(
+                f"member {m} needs applied state at index {need} but no peer "
+                f"has applied that far; host state machine cannot catch up"
+            )
+        donor = max(donors, key=lambda d: self.members[d].applied_index)
+        self.restore_member(m, self.member_snapshot(donor))
+
+    # -- state-machine snapshots (full applied state, not just KV) ----------
+    def member_snapshot(self, m: int) -> dict:
+        """Everything needed to reconstruct a member's applied state at its
+        applied_index: MVCC + lessor + auth + alarms (the merged
+        WAL-snapshot + backend `.snap.db` of snapshot_merge.go:85)."""
+        ms = self.members[m]
+        return {
+            "applied_index": ms.applied_index,
+            "kv": ms.store.kv.to_snapshot(),
+            "lease": ms.lessor.to_snapshot(),
+            "auth": ms.auth.to_snapshot(),
+            "alarms": sorted(ms.alarms),
+        }
+
+    def restore_member(self, m: int, snap: dict) -> None:
+        from etcd_tpu.server.mvcc import MVCCStore
+
+        ms = self.members[m]
+        ms.store.restore(MVCCStore.from_snapshot(snap["kv"]))
+        ms.lessor.restore(snap["lease"])
+        ms.auth.restore(snap["auth"])
+        ms.alarms = set(snap["alarms"])
+        ms.applied_index = snap["applied_index"]
+        ms.results.clear()
 
     def _gc_requests(self) -> None:
         """Drop request payloads every configured member has applied (the
@@ -201,9 +263,9 @@ class EtcdCluster:
         ref = max(range(self.M), key=lambda m: self.members[m].applied_index)
         s = self.cl.s
         conf = (
-            np.asarray(s.voters[self.c, ref])
-            | np.asarray(s.voters_out[self.c, ref])
-            | np.asarray(s.learners[self.c, ref])
+            np.asarray(s.voters[ref, ..., self.c])
+            | np.asarray(s.voters_out[ref, ..., self.c])
+            | np.asarray(s.learners[ref, ..., self.c])
         )
         floor = min(
             self.members[m].applied_index for m in range(self.M) if conf[m]
@@ -369,11 +431,12 @@ class EtcdCluster:
             "auth_enable": lambda: a.auth_enable(),
             "auth_disable": lambda: a.auth_disable(),
             "auth_user_add": lambda: a.user_add(
-                req["name"], req.get("password", ""), req.get("no_password", False)
+                req["name"], no_password=req.get("no_password", False),
+                salt=req.get("salt"), pw_hash=req.get("pw_hash"),
             ),
             "auth_user_delete": lambda: a.user_delete(req["name"]),
             "auth_user_change_password": lambda: a.user_change_password(
-                req["name"], req["password"]
+                req["name"], salt=req.get("salt"), pw_hash=req.get("pw_hash")
             ),
             "auth_user_grant_role": lambda: a.user_grant_role(
                 req["name"], req["role"]
@@ -402,7 +465,7 @@ class EtcdCluster:
         at = member if member is not None else lead
         # backpressure: commit-apply gap (v3_server.go:644-648)
         s = self.cl.s
-        gap = int(np.asarray(s.commit[self.c, at])) - self.members[at].applied_index
+        gap = int(np.asarray(s.commit[at, ..., self.c])) - self.members[at].applied_index
         if gap > self.MAX_GAP:
             raise ErrTooManyRequests()
         word = self._next_word
@@ -426,7 +489,7 @@ class EtcdCluster:
             cluster_id=self.c,
             member_id=m,
             revision=self.members[m].store.kv.current_rev,
-            raft_term=int(np.asarray(s.term[self.c, m])),
+            raft_term=int(np.asarray(s.term[m, ..., self.c])),
         )
 
     # ------------------------------------------------------------- public KV
@@ -484,8 +547,8 @@ class EtcdCluster:
         ctx = self.cl.read_index(member, c=self.c)
         for _ in range(self.MAX_APPLY_WAIT_ROUNDS):
             self.step()
-            rs_ctx = np.asarray(self.cl.s.rs_ctx[self.c, member])
-            rs_idx = np.asarray(self.cl.s.rs_index[self.c, member])
+            rs_ctx = np.asarray(self.cl.s.rs_ctx[member, ..., self.c])
+            rs_idx = np.asarray(self.cl.s.rs_index[member, ..., self.c])
             hits = np.nonzero(rs_ctx == ctx)[0]
             if hits.size:
                 need = int(rs_idx[hits[0]])
@@ -574,15 +637,15 @@ class EtcdCluster:
         s = self.cl.s
         lead = self.ensure_leader()
         cfg = HostConfig()
-        v = np.asarray(s.voters[self.c, lead])
-        vo = np.asarray(s.voters_out[self.c, lead])
-        l = np.asarray(s.learners[self.c, lead])
-        ln = np.asarray(s.learners_next[self.c, lead])
+        v = np.asarray(s.voters[lead, ..., self.c])
+        vo = np.asarray(s.voters_out[lead, ..., self.c])
+        l = np.asarray(s.learners[lead, ..., self.c])
+        ln = np.asarray(s.learners_next[lead, ..., self.c])
         cfg.voters = {i for i in range(self.M) if v[i]}
         cfg.voters_outgoing = {i for i in range(self.M) if vo[i]}
         cfg.learners = {i for i in range(self.M) if l[i]}
         cfg.learners_next = {i for i in range(self.M) if ln[i]}
-        cfg.auto_leave = bool(np.asarray(s.auto_leave[self.c, lead]))
+        cfg.auto_leave = bool(np.asarray(s.auto_leave[lead, ..., self.c]))
         cfg.progress = cfg.voters | cfg.voters_outgoing | cfg.learners
         cfg.progress_learner = set(cfg.learners)
         return cfg
@@ -634,8 +697,8 @@ class EtcdCluster:
 
         lead = self.ensure_leader()
         s = self.cl.s
-        match = int(np.asarray(s.match[self.c, lead, member_id]))
-        last = int(np.asarray(s.last_index[self.c, lead]))
+        match = int(np.asarray(s.match[lead, member_id, ..., self.c]))
+        last = int(np.asarray(s.last_index[lead, ..., self.c]))
         if last > 0 and match < last * 9 // 10:
             raise ServerError("learner is not ready to be promoted")
         self._conf_change(
@@ -656,6 +719,24 @@ class EtcdCluster:
         a.check(token, key, range_end, write)
 
     def auth_request(self, kind: str, **kw):
+        # Hash passwords once at propose time and replicate (salt, hash) in
+        # the entry, like auth/store.go replicating the bcrypt hash inside
+        # AuthUserAdd — apply stays deterministic across members and replays.
+        if kind in ("auth_user_add", "auth_user_change_password"):
+            import os as _os
+
+            from etcd_tpu.server.auth import _hash
+
+            if not kw.get("no_password"):
+                salt = _os.urandom(16)
+                kw["salt"] = salt
+                kw["pw_hash"] = _hash(kw.pop("password", ""), salt)
+            else:
+                # no_password users still need a deterministic (empty) salt,
+                # or each member would roll its own urandom at apply time
+                kw.pop("password", None)
+                kw["salt"] = b""
+                kw["pw_hash"] = b""
         return self._propose({"kind": kind, **kw})
 
     def authenticate(self, name: str, password: str) -> str:
@@ -668,11 +749,11 @@ class EtcdCluster:
         ms = self.members[member]
         return {
             "leader": self.leader(),
-            "raft_term": int(np.asarray(s.term[self.c, member])),
-            "raft_index": int(np.asarray(s.last_index[self.c, member])),
+            "raft_term": int(np.asarray(s.term[member, ..., self.c])),
+            "raft_index": int(np.asarray(s.last_index[member, ..., self.c])),
             "raft_applied_index": ms.applied_index,
             "db_size": ms.store.kv.size,
-            "is_learner": bool(np.asarray(s.learners[self.c, member, member])),
+            "is_learner": bool(np.asarray(s.learners[member, member, ..., self.c])),
             "alarms": sorted(ms.alarms),
         }
 

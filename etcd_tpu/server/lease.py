@@ -142,6 +142,36 @@ class Lessor:
         if l is not None:
             l.remaining_checkpoint = remaining
 
+    # -- snapshot/restore (leaseBucket persistence, schema/lease.go) ---------
+    def to_snapshot(self) -> dict:
+        """(ttl, remaining, keys) per lease; remaining is measured from the
+        snapshot moment so the restored member's local clock origin doesn't
+        matter (the reference persists ID+TTL and checkpoints remaining)."""
+        return {
+            l.id: {
+                "ttl": l.ttl,
+                "remaining": (
+                    max(l.expiry - self.now, 0)
+                    if self.primary
+                    else l.remaining_checkpoint
+                ),
+                "keys": sorted(l.keys),
+            }
+            for l in self.leases.values()
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.leases = {}
+        self.item_map = {}
+        self.primary = False
+        self._heap = []
+        for lid, d in snap.items():
+            l = Lease(lid, d["ttl"], self.now + (d["remaining"] or d["ttl"]),
+                      set(d["keys"]), d["remaining"])
+            self.leases[lid] = l
+            for k in l.keys:
+                self.item_map[k] = lid
+
     # -- expiry (lessor.go expireExists / runLoop) ---------------------------
     def expired(self, limit: int = 16) -> list[int]:
         """Lease ids due at the current tick (primary only). The server

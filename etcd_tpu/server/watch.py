@@ -85,6 +85,19 @@ class WatchableStore:
             or self.unsynced.pop(watch_id, None) is not None
         )
 
+    def restore(self, store: MVCCStore) -> None:
+        """Install a snapshot store (the applySnapshot path,
+        server.go:925-1061: the state machine jumps to the snapshot and
+        every watcher re-syncs from history). Watchers whose start_rev was
+        compacted away are cancelled with `compacted` by the next
+        sync_watchers pass."""
+        self.kv = store
+        cur = store.current_rev
+        for wid, w in list(self.synced.items()):
+            if w.start_rev <= cur:  # future-rev watchers stay synced
+                del self.synced[wid]
+                self.unsynced[wid] = w
+
     # -- write-path publication (watchable_store_txn.go:22) ------------------
     def notify(self, events: list[tuple[str, KeyValue, KeyValue | None]]):
         for typ, kv, prev in events:
@@ -93,9 +106,17 @@ class WatchableStore:
                     continue
                 if len(w.buffer) >= Watcher.MAX_BUFFER:
                     # slow watcher becomes a victim; it will be re-synced
-                    # from history later (victims queue)
+                    # from history later (victims queue). The catch-up path
+                    # replays whole revisions, so roll back to the start of
+                    # this (possibly multi-op) revision and drop its
+                    # already-buffered prefix — otherwise those events would
+                    # be delivered twice (sync_watchers' split-at-main-
+                    # revision rule, applied to the victim path).
                     w.victim = True
-                    w.start_rev = kv.mod_revision
+                    rev = kv.mod_revision
+                    while w.buffer and w.buffer[-1].kv.mod_revision == rev:
+                        w.buffer.pop()
+                    w.start_rev = rev
                     continue
                 w.buffer.append(
                     Event(typ, kv, prev if w.prev_kv else None)

@@ -36,9 +36,16 @@ class WAL:
         self._f = None
         self.seq = 0
         self.enti = 0  # index of the last entry record appended
+        self.metadata = metadata
         os.makedirs(dirpath, exist_ok=True)
         if not self._segments():
             self._cut_to(0, 0, metadata)
+        else:
+            # opening an existing log: replay to the tail so crc/enti/seq are
+            # restored and the last segment is open for append — save()
+            # before an explicit read_all() must not write blind (wal.go
+            # Open reads to tail before the WAL is appendable)
+            self.read_all()
 
     # -- segments ------------------------------------------------------------
     def _segments(self) -> list[str]:
@@ -66,7 +73,10 @@ class WAL:
 
     def _maybe_cut(self) -> None:
         if self._f.tell() >= SEGMENT_BYTES:
-            self._cut_to(self.seq + 1, self.enti + 1)
+            # every segment re-carries the metadata record so any suffix of
+            # segments replays standalone (wal.go cut writes metadata into
+            # each new file)
+            self._cut_to(self.seq + 1, self.enti + 1, self.metadata)
 
     # -- append --------------------------------------------------------------
     def _append(self, rtype: int, payload: bytes) -> None:
@@ -148,9 +158,12 @@ class WAL:
             from_index, snapshot["index"] if snapshot else 0
         )
         entries = [by_index[i] for i in sorted(by_index) if i > start]
+        if metadata:
+            self.metadata = metadata
         # reopen tail for appending
         if self._f is None or self._f.closed:
             segs = self._segments()
+            self.seq = int(segs[-1].split("-")[0], 16)
             self._f = open(os.path.join(self.dir, segs[-1]), "ab")
         if by_index:
             self.enti = max(by_index)

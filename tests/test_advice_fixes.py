@@ -1,0 +1,144 @@
+"""Regression tests for the round-1 advisor findings: deterministic auth
+applies, WAL open-for-append, watcher-overflow revision rollback, and the
+peer-snapshot path when the device log compacts past a member's host-applied
+cursor."""
+import numpy as np
+import pytest
+
+from etcd_tpu.server.auth import AuthStore
+from etcd_tpu.server.kvserver import EtcdCluster, ErrCorrupt
+from etcd_tpu.server.mvcc import MVCCStore
+from etcd_tpu.server.watch import WatchableStore, Watcher
+from etcd_tpu.storage.wal import WAL
+
+
+# ---------------------------------------------------------------- auth salt
+def test_auth_apply_is_deterministic_across_members():
+    """user_add/change_password hash at propose time and replicate
+    (salt, hash), so every member holds identical auth state
+    (auth/store.go stores the hash carried in the AuthUserAdd request)."""
+    srv = EtcdCluster(n_members=3)
+    srv.ensure_leader()
+    srv.auth_request("auth_user_add", name="alice", password="secret")
+    srv.auth_request("auth_user_change_password", name="alice",
+                     password="rotated")
+    srv.stabilize()
+    users = [srv.members[m].auth.users["alice"] for m in range(3)]
+    assert users[0].salt == users[1].salt == users[2].salt
+    assert users[0].pw_hash == users[1].pw_hash == users[2].pw_hash
+    # and the replicated hash actually verifies the password
+    srv.auth_request("auth_role_add", name="r")
+    srv.auth_request("auth_user_grant_role", name="alice", role="r")
+    assert srv.members[0].auth.users["alice"].pw_hash
+
+
+def test_auth_store_restore_roundtrip():
+    a = AuthStore()
+    a.user_add("root", "pw")
+    a.role_add("root")
+    a.user_grant_role("root", "root")
+    a.auth_enable()
+    b = AuthStore()
+    b.restore(a.to_snapshot())
+    assert b.enabled and b.revision == a.revision
+    assert b.users["root"].pw_hash == a.users["root"].pw_hash
+    assert b.users["root"].roles == {"root"}
+
+
+# ---------------------------------------------------------------- WAL open
+def test_wal_open_existing_then_save(tmp_path):
+    """WAL(dir); wal.save(...) on a pre-existing log appends at the tail
+    (wal.go Open reads to tail before the WAL is appendable)."""
+    d = str(tmp_path / "wal")
+    w = WAL(d, metadata=b"node1")
+    w.save({"term": 1, "vote": 0, "commit": 0}, [{"index": 1, "term": 1}])
+    w.close()
+    w2 = WAL(d)  # no explicit read_all
+    w2.save({"term": 1, "vote": 0, "commit": 1}, [{"index": 2, "term": 1}])
+    w2.close()
+    meta, hs, ents, snap = WAL(d).read_all()
+    assert meta == b"node1"
+    assert [e["index"] for e in ents] == [1, 2]
+    assert hs["commit"] == 1
+
+
+def test_wal_metadata_survives_segment_cut(tmp_path, monkeypatch):
+    """Segments created by cut carry the metadata record, so metadata
+    survives release_to() dropping the first segment (wal.go cut)."""
+    import etcd_tpu.storage.wal as walmod
+
+    monkeypatch.setattr(walmod, "SEGMENT_BYTES", 256)
+    d = str(tmp_path / "wal")
+    w = WAL(d, metadata=b"m0")
+    for i in range(1, 40):
+        w.save({"term": 1, "vote": 0, "commit": i},
+               [{"index": i, "term": 1, "data": b"x" * 32}])
+    w.save_snapshot(30, 1)
+    assert len(w._segments()) > 1
+    w.release_to(30)
+    w.close()
+    meta, _, _, _ = WAL(d).read_all()
+    assert meta == b"m0"
+
+
+# ------------------------------------------------------------- watch victim
+def test_watch_overflow_no_duplicate_events(monkeypatch):
+    """A synced watcher overflowing mid-revision rolls back to the revision
+    boundary: after catch-up the client sees every event exactly once."""
+    monkeypatch.setattr(Watcher, "MAX_BUFFER", 3)
+    ws = WatchableStore()
+    w = ws.watch(b"k", range_end=b"\x00")
+    # txn 1: two ops at one revision (fills buffer to 2)
+    txn = ws.kv.write_txn()
+    txn.put(b"k1", b"a")
+    txn.put(b"k2", b"b")
+    txn.end()
+    ws.notify(txn.events)
+    # txn 2: two ops at one revision; second op overflows MAX_BUFFER=3
+    txn = ws.kv.write_txn()
+    txn.put(b"k3", b"c")
+    txn.put(b"k4", b"d")
+    txn.end()
+    ws.notify(txn.events)
+    assert w.victim
+    got = [e.kv.key for e in ws.take_events(w.id)]
+    # catch-up must deliver the whole second revision exactly once
+    while ws.sync_watchers() == 0 and (w.victim or w.id in ws.unsynced):
+        pass
+    got += [e.kv.key for e in ws.take_events(w.id)]
+    assert got == [b"k1", b"k2", b"k3", b"k4"]
+
+
+# ------------------------------------------------- peer snapshot install
+def test_member_snapshot_restore_roundtrip():
+    srv = EtcdCluster(n_members=3)
+    srv.ensure_leader()
+    srv.put(b"a", b"1")
+    srv.put(b"b", b"2")
+    srv.lease_grant(7, 30)
+    srv.stabilize()
+    snap = srv.member_snapshot(0)
+    # wipe member 2 and restore from member 0's snapshot
+    srv.restore_member(2, snap)
+    assert srv.members[2].applied_index == srv.members[0].applied_index
+    assert srv.hash_kv(2) == srv.hash_kv(0)
+    assert 7 in srv.members[2].lessor.leases
+
+
+def test_pump_gap_installs_peer_snapshot_or_fails_loudly():
+    srv = EtcdCluster(n_members=3)
+    srv.ensure_leader()
+    for i in range(4):
+        srv.put(b"k%d" % i, b"v%d" % i)
+    srv.stabilize()
+    # simulate a member whose host apply fell behind a device snapshot
+    ms = srv.members[2]
+    ms.store.restore(MVCCStore())
+    ms.lessor.restore({})
+    ms.applied_index = 0
+    srv._install_peer_snapshot(2, ms, need=srv.members[0].applied_index)
+    assert srv.hash_kv(2) == srv.hash_kv(0)
+    assert ms.applied_index == srv.members[0].applied_index
+    # no donor far enough -> loud failure, not silent divergence
+    with pytest.raises(ErrCorrupt):
+        srv._install_peer_snapshot(2, ms, need=10**9)

@@ -21,10 +21,10 @@ from etcd_tpu.types import (
 def masks(cl, m, c=0):
     s = cl.s
     return (
-        np.asarray(s.voters[c, m]).tolist(),
-        np.asarray(s.voters_out[c, m]).tolist(),
-        np.asarray(s.learners[c, m]).tolist(),
-        np.asarray(s.learners_next[c, m]).tolist(),
+        np.asarray(s.voters[m, ..., c]).tolist(),
+        np.asarray(s.voters_out[m, ..., c]).tolist(),
+        np.asarray(s.learners[m, ..., c]).tolist(),
+        np.asarray(s.learners_next[m, ..., c]).tolist(),
     )
 
 

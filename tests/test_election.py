@@ -30,7 +30,7 @@ def test_three_node_election():
     assert cl.roles().tolist() == [ROLE_LEADER, ROLE_FOLLOWER, ROLE_FOLLOWER]
     assert cl.terms().tolist() == [1, 1, 1]
     # every node learned the leader
-    assert np.asarray(cl.s.lead[0]).tolist() == [0, 0, 0]
+    assert cl.leaf("lead").tolist() == [0, 0, 0]
 
 
 def test_five_node_election():
@@ -63,7 +63,7 @@ def test_follower_votes_at_most_once_per_term():
     cl.stabilize()
     leaders = [m for m in range(3) if cl.roles()[m] == ROLE_LEADER]
     assert len(leaders) <= 1
-    votes = np.asarray(cl.s.vote[0])
+    votes = cl.leaf("vote")
     # node 2 voted for exactly one of the candidates in term 1
     assert votes[2] in (0, 1)
 

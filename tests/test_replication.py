@@ -15,8 +15,8 @@ from etcd_tpu.types import NONE_ID, ROLE_LEADER, Spec
 def applied_consistent(cl, c: int = 0):
     """Functional-tester KV_HASH analog: equal applied => equal hash chain."""
     s = cl.s
-    applied = np.asarray(s.applied[c])
-    hashes = np.asarray(s.applied_hash[c])
+    applied = np.asarray(s.applied[..., c])
+    hashes = np.asarray(s.applied_hash[..., c])
     by_applied = {}
     for m in range(applied.shape[0]):
         by_applied.setdefault(int(applied[m]), set()).add(int(hashes[m]))
@@ -36,7 +36,7 @@ def test_leader_start_replication_and_commit():
     want = [(1, 0), (1, 101), (1, 102)]
     for m in range(3):
         assert cl.log_entries(m) == want
-    assert np.asarray(cl.s.applied[0]).tolist() == [3, 3, 3]
+    assert cl.leaf("applied").tolist() == [3, 3, 3]
     assert applied_consistent(cl)
 
 

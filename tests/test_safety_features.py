@@ -93,7 +93,7 @@ def test_check_quorum_lease_protects_leader():
     lead = cl.leader()
     assert lead != NONE_ID
     assert len(set(cl.terms().tolist())) == 1
-    assert np.asarray(cl.s.commit[0]).min() >= 2
+    assert cl.commits().min() >= 2
 
 
 def test_leader_transfer():
@@ -105,16 +105,11 @@ def test_leader_transfer():
     cl.propose(0, 8)
     cl.stabilize()
     # admin injects MsgTransferLeader at the leader, From = transferee (1)
-    import jax.numpy as jnp
     from etcd_tpu.types import MSG_TRANSFER_LEADER
 
-    ib = cl.eng.inbox
-    ib = ib.replace(
-        type=ib.type.at[0, 0, 1, 0].set(MSG_TRANSFER_LEADER),
-        frm=ib.frm.at[0, 0, 1, 0].set(1),
-        term=ib.term.at[0, 0, 1, 0].set(int(cl.terms()[0])),
+    cl.inject(
+        to=0, frm=1, type=MSG_TRANSFER_LEADER, term=int(cl.terms()[0])
     )
-    cl.eng.inbox = ib
     cl.stabilize()
     assert cl.leader() == 1
     assert int(cl.terms()[1]) == 2
@@ -147,6 +142,6 @@ def test_read_index_forwarded_from_follower():
     ctx = cl.read_index(2)
     cl.stabilize()
     s = cl.s
-    assert int(s.rs_count[0, 2]) == 1
-    assert int(s.rs_ctx[0, 2, 0]) == ctx
-    assert int(s.rs_index[0, 2, 0]) == int(cl.commits()[0])
+    assert cl.get("rs_count", 2) == 1
+    assert int(cl.get("rs_ctx", 2)[0]) == ctx
+    assert int(cl.get("rs_index", 2)[0]) == int(cl.commits()[0])
