@@ -23,6 +23,17 @@ import jax.numpy as jnp
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+# the unrolled round program is compile-heavy (minutes per (Spec, C) shape);
+# persist compilations so repeated bench runs start hot
+os.makedirs(os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"),
+            exist_ok=True)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
 BASELINE_GROUP_ROUNDS_PER_SEC = 1_000_000 * 10_000  # 1M groups x 10k rounds/s
 
 
@@ -41,8 +52,14 @@ def main() -> None:
     inner = int(os.environ.get("BENCH_ROUNDS", 32 if on_accel else 8))
     reps = int(os.environ.get("BENCH_REPS", 5 if on_accel else 2))
 
-    spec = Spec(M=5, L=32, E=1, K=4, W=4, R=2, A=2)
-    cfg = RaftConfig(pre_vote=True, check_quorum=True)
+    # K=2 message slots: in the no-tick steady state each follower sees one
+    # MsgApp per round (appends double as heartbeats, exactly the
+    # reference's design point of ~1000 writes between 100ms ticks,
+    # server/etcdserver/raft.go:33-38). unroll_messages: the lax.scan
+    # while-loop costs ~10-25ms of fixed runtime per message on TPU, so the
+    # perf path runs the straight-line unrolled round program.
+    spec = Spec(M=5, L=32, E=1, K=2, W=4, R=2, A=2)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, unroll_messages=True)
     M, E = spec.M, spec.E
 
     devs = jax.devices()
@@ -78,11 +95,15 @@ def main() -> None:
     assert n_leaders == C, f"expected {C} leaders, got {n_leaders}"
     assert int((inbox.type != 0).sum()) == 0, "network not quiescent after settle"
 
-    # -- steady state: 1 proposal/group/round at the leader (node 0) --------
+    # -- steady state: 1 proposal/group/round at the leader (node 0).
+    # No ticks in the timed region: a consensus round is ~ms while the
+    # reference's tick is 100ms, so ticking every round would model a
+    # wildly faster clock, and each heartbeat fan-out would double the
+    # message load. Appends act as leader liveness, as in the reference.
     prop_len = z2.at[0].set(1)
     prop_data = zp.at[0, 0].set(7)
     run = build_scan_rounds(cfg, spec, mesh, rounds=inner)
-    args = (prop_len, prop_data, zp, z2, no_hup, tick, keep)
+    args = (prop_len, prop_data, zp, z2, no_hup, no_tick, keep)
 
     state, inbox = run(state, inbox, *args)  # compile + warm
     jax.block_until_ready(state.commit)

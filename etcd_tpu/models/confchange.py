@@ -153,7 +153,9 @@ def apply_conf_change(cfg, spec, n, ob, data, enable):
     )
     # abort a transfer to a peer no longer in the voter union (raft.go:1694-1697)
     tr = jnp.clip(n.lead_transferee, 0, spec.M - 1)
-    gone = (n.lead_transferee != NONE_ID) & ~(n.voters | n.voters_out)[tr]
+    gone = (n.lead_transferee != NONE_ID) & ~raftmod.onehot_sel(
+        n.voters | n.voters_out, tr
+    )
     n = n.replace(
         lead_transferee=jnp.where(enable & gone, NONE_ID, n.lead_transferee)
     )

@@ -20,6 +20,31 @@ def slot(spec: Spec, idx: jnp.ndarray) -> jnp.ndarray:
     return (idx - 1) % spec.L
 
 
+def ring_read(ring: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """ring[s] without a gather: one-hot mask-and-reduce over the small
+    static L axis. Dynamic per-lane indexing lowers to an HLO gather, which
+    the TPU executes as a serial scan (measured ~10ms per [M, C] gather at
+    C=2k — 1000x the cost of this reduce); with L<=64 the one-hot contraction
+    stays in the VPU and fuses with its producers.
+
+    ring: [L]; s: scalar or [...]-shaped indices. Returns s-shaped values.
+    """
+    L = ring.shape[-1]
+    oh = jnp.arange(L, dtype=jnp.int32) == jnp.asarray(s)[..., None]  # [..., L]
+    return jnp.where(oh, ring, 0).sum(axis=-1).astype(ring.dtype)
+
+
+def roll_left(a: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """jnp.roll(a, -k, axis=0) for a traced k without a gather (dynamic
+    roll lowers to one — see ring_read): one-hot permutation matrix over
+    the small static leading axis, trailing dims carried along."""
+    N = a.shape[0]
+    offs = jnp.arange(N, dtype=jnp.int32)
+    sh = offs[:, None] == ((offs[None, :] + k) % N)  # [src, dst]
+    oh = sh.reshape(sh.shape + (1,) * (a.ndim - 1))
+    return jnp.where(oh, a[:, None], 0).sum(axis=0).astype(a.dtype)
+
+
 def first_index(n: NodeState) -> jnp.ndarray:
     return n.snap_index + 1
 
@@ -29,7 +54,7 @@ def term_at(spec: Spec, n: NodeState, idx: jnp.ndarray):
     [snap_index, last_index] (the reference returns (0, nil) below the dummy
     index and errors inside the compacted range; callers here only need the
     combined "can't tell" signal)."""
-    t = n.log_term[slot(spec, idx)]
+    t = ring_read(n.log_term, slot(spec, idx))
     t = jnp.where(idx == n.snap_index, n.snap_term, t)
     ok = (idx >= n.snap_index) & (idx <= n.last_index)
     return jnp.where(ok, t, 0).astype(jnp.int32), ok
@@ -96,21 +121,25 @@ def append_span(
     After the write last_index = prev_index + ent_len (truncation semantics of
     unstable.truncateAndAppend, log_unstable.go:121)."""
     new_last = prev_index + ent_len
-    for e in range(spec.E):
-        idx = prev_index + 1 + e
-        write = enable & (e < ent_len)
-        s = slot(spec, idx)
-        n = n.replace(
-            log_term=n.log_term.at[s].set(
-                jnp.where(write, ent_term[e], n.log_term[s])
-            ),
-            log_data=n.log_data.at[s].set(
-                jnp.where(write, ent_data[e], n.log_data[s])
-            ),
-            log_type=n.log_type.at[s].set(
-                jnp.where(write, ent_type[e], n.log_type[s])
-            ),
-        )
+    # all E offered slots written in one one-hot pass (consecutive indexes
+    # map to distinct ring slots, so at most one e hits each slot)
+    offs = jnp.arange(spec.E, dtype=jnp.int32)
+    s = slot(spec, prev_index + 1 + offs)  # [E]
+    write = enable & (offs < ent_len)  # [E]
+    oh = (jnp.arange(spec.L, dtype=jnp.int32)[None, :] == s[:, None]) & (
+        write[:, None]
+    )  # [E, L]
+    hit = oh.any(axis=0)  # [L]
+
+    def merge(ring, vals):
+        new = jnp.where(oh, vals[:, None], 0).sum(axis=0).astype(ring.dtype)
+        return jnp.where(hit, new, ring)
+
+    n = n.replace(
+        log_term=merge(n.log_term, ent_term),
+        log_data=merge(n.log_data, ent_data),
+        log_type=merge(n.log_type, ent_type),
+    )
     return n.replace(last_index=jnp.where(enable, new_last, n.last_index))
 
 
@@ -149,9 +178,9 @@ def maybe_append(
     ci_off = jnp.where(any_conflict, jnp.argmax(mismatch), 0).astype(jnp.int32)
 
     # append entries [ci, last_new_i]; shift the offered span left by ci_off
-    # so append_span sees prev_index = m_index + ci_off.
+    # so append_span sees prev_index = m_index + ci_off
     def shift(a):
-        return jnp.roll(a, -ci_off, axis=0)
+        return roll_left(a, ci_off)
 
     n = append_span(
         spec,
@@ -181,9 +210,9 @@ def entries_from(spec: Spec, n: NodeState, lo: jnp.ndarray):
     zero = jnp.zeros((spec.E,), jnp.int32)
     return (
         ln,
-        jnp.where(valid, n.log_term[s], zero),
-        jnp.where(valid, n.log_data[s], zero),
-        jnp.where(valid, n.log_type[s], zero),
+        jnp.where(valid, ring_read(n.log_term, s), zero),
+        jnp.where(valid, ring_read(n.log_data, s), zero),
+        jnp.where(valid, ring_read(n.log_type, s), zero),
     )
 
 

@@ -29,9 +29,21 @@ def committed_index(voters: jnp.ndarray, acked: jnp.ndarray) -> jnp.ndarray:
     """
     n = voters.sum().astype(jnp.int32)
     vals = jnp.where(voters, acked, INT32_MAX)
-    srt = jnp.sort(vals)  # ascending: voters occupy positions [0, n)
     pos = jnp.maximum(n - (n // 2 + 1), 0)
-    return jnp.where(n == 0, INT32_MAX, srt[pos]).astype(jnp.int32)
+    # k-th smallest by rank counting instead of jnp.sort + dynamic index:
+    # HLO sort and gather both fall off the vector path on TPU (measured
+    # ~100x the cost of this [M, M] comparison triangle at M<=7, the same
+    # size the reference bounds its stack-allocated insertion sort to,
+    # majority.go:126-172). Ties break by member id, making `rank` a
+    # permutation, so exactly one element holds rank == pos.
+    M = vals.shape[0]
+    ids = jnp.arange(M, dtype=jnp.int32)
+    lt = (vals[None, :] < vals[:, None]) | (
+        (vals[None, :] == vals[:, None]) & (ids[None, :] < ids[:, None])
+    )
+    rank = lt.sum(axis=-1).astype(jnp.int32)
+    kth = jnp.where(rank == pos, vals, 0).sum().astype(jnp.int32)
+    return jnp.where(n == 0, INT32_MAX, kth).astype(jnp.int32)
 
 
 def joint_committed_index(

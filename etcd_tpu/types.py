@@ -102,6 +102,14 @@ class Spec:
     R: int = 4        # read-only request queue depth
     A: int = 8        # max committed entries applied per node per round
 
+    def __post_init__(self):
+        if self.E > self.L:
+            # append_span's one-hot merge assumes one offered span never
+            # wraps the ring onto itself (distinct slots per entry)
+            raise ValueError(f"Spec.E ({self.E}) must be <= Spec.L ({self.L})")
+        if self.M > 31:
+            raise ValueError("Spec.M must fit the 5-bit conf-change id field")
+
 
 # ---------------------------------------------------------------------------
 # Message struct-of-arrays
