@@ -59,7 +59,10 @@ def main() -> None:
     # while-loop costs ~10-25ms of fixed runtime per message on TPU, so the
     # perf path runs the straight-line unrolled round program.
     spec = Spec(M=5, L=32, E=1, K=2, W=4, R=2, A=2)
-    cfg = RaftConfig(pre_vote=True, check_quorum=True, unroll_messages=True)
+    # BENCH_UNROLL=0 keeps the lax.scan round (fast compile) for smoke
+    # runs off-TPU; the perf path default is the unrolled program.
+    unroll = os.environ.get("BENCH_UNROLL", "1" if on_accel else "0") != "0"
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, unroll_messages=unroll)
     M, E = spec.M, spec.E
 
     devs = jax.devices()
@@ -116,6 +119,17 @@ def main() -> None:
         jax.block_until_ready(state.commit)
         best = min(best, time.perf_counter() - t0)
 
+    # optional profiler capture of one timed run (the JAX-trace analog of
+    # the reference's pprof/tracing endpoints, SURVEY §5)
+    if os.environ.get("BENCH_PROFILE"):
+        trace_dir = os.path.join(
+            os.path.dirname(__file__) or ".", "bench_trace"
+        )
+        with jax.profiler.trace(trace_dir):
+            state, inbox = run(state, inbox, *args)
+            jax.block_until_ready(state.commit)
+        print(f"# profiler trace written to {trace_dir}", file=sys.stderr)
+
     rounds_per_sec = inner / best
     group_rounds_per_sec = C * rounds_per_sec
 
@@ -129,6 +143,28 @@ def main() -> None:
         "fleet is not in one-commit-per-round steady state"
     )
 
+    # observability pass: a few metered rounds (fused counters; see
+    # etcd_tpu/models/metrics.py) so the report carries election/lag stats
+    from etcd_tpu.models.metrics import (
+        build_metered_round,
+        metrics_report,
+        zero_metrics,
+    )
+    import dataclasses as _dc
+
+    met_cfg = _dc.replace(cfg, unroll_messages=False)
+    met_step = jax.jit(build_metered_round(met_cfg, spec))
+    metrics = zero_metrics()
+    mrounds = 8
+    t0 = time.perf_counter()
+    for _ in range(mrounds):
+        state, inbox, metrics = met_step(
+            state, inbox, prop_len, prop_data, zp, z2, no_hup, no_tick,
+            keep, metrics,
+        )
+    jax.block_until_ready(metrics.commits)
+    rep = metrics_report(metrics, time.perf_counter() - t0, C, spec.M)
+
     print(
         json.dumps(
             {
@@ -139,6 +175,13 @@ def main() -> None:
                 "vs_baseline": round(
                     group_rounds_per_sec / BASELINE_GROUP_ROUNDS_PER_SEC, 4
                 ),
+                "elections_won": rep["elections_won"],
+                "leader_losses": rep["leader_losses"],
+                "commits_per_group_per_round": rep[
+                    "commits_per_group_per_round"
+                ],
+                "commit_apply_lag_hist": rep["commit_apply_lag_hist"],
+                "msgs_dropped": rep["msgs_dropped"],
             }
         )
     )

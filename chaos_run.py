@@ -1,0 +1,69 @@
+"""Scale chaos driver: BASELINE configs #3/#5 on real hardware.
+
+Runs the functional chaos loop (etcd_tpu/harness/chaos.py) at
+CHAOS_C groups x CHAOS_ROUNDS rounds with randomized drop/delay/partition
+faults and on-device safety checkers, then prints ONE JSON line with the
+violation counts and liveness stats. Evidence files: CHAOS_r*.json.
+
+Usage: CHAOS_C=1000000 CHAOS_ROUNDS=200 python chaos_run.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+os.makedirs(os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"),
+            exist_ok=True)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+
+def main() -> int:
+    from etcd_tpu.harness.chaos import run_chaos
+    from etcd_tpu.types import Spec
+    from etcd_tpu.utils.config import RaftConfig
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    C = int(os.environ.get("CHAOS_C", 100_000 if on_accel else 1_000))
+    rounds = int(os.environ.get("CHAOS_ROUNDS", 200))
+
+    spec = Spec(M=5, L=32, E=2, K=4, W=2, R=2, A=4)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True)
+
+    t0 = time.perf_counter()
+    rep = run_chaos(
+        spec, cfg, C=C, rounds=rounds, epoch_len=50, heal_len=25,
+        seed=int(os.environ.get("CHAOS_SEED", "0")),
+        drop_p=float(os.environ.get("CHAOS_DROP", "0.02")),
+        delay_p=float(os.environ.get("CHAOS_DELAY", "0.05")),
+        partition_p=float(os.environ.get("CHAOS_PART", "0.1")),
+    )
+    rep["elapsed_s"] = round(time.perf_counter() - t0, 1)
+    rep["platform"] = platform
+    rep["safe"] = (
+        rep["multi_leader"] == 0
+        and rep["hash_mismatch"] == 0
+        and rep["commit_regress"] == 0
+    )
+    rep["recovered"] = (
+        rep["groups_with_leader_after_heal"] == rep["groups"]
+        and rep["heal_commits_last_epoch"] > 0
+    )
+    print(json.dumps(rep))
+    return 0 if (rep["safe"] and rep["recovered"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

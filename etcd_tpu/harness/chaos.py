@@ -1,0 +1,253 @@
+"""Functional chaos tier: randomized faults + on-device invariant checkers.
+
+The reference's functional tester (tests/functional/tester/cluster.go:43-65)
+loops rounds of inject -> stress -> recover -> check over a live cluster,
+with fault cases like BLACKHOLE/DELAY_PEER_PORT_TX_RX (rpcpb enum) injected
+by an L4 proxy (pkg/proxy/server.go:92-127) and a KV_HASH checker
+(tester/checker_kv_hash.go) asserting every member converges to the same
+state hash.
+
+The TPU-native equivalent runs the whole loop ON DEVICE at fleet scale:
+
+  * drop faults: per-round Bernoulli keep-masks (the blackhole case);
+  * partition faults: rolling per-group bisections re-sampled every epoch
+    (SIGQUIT/blackhole-quorum analogs), healed between epochs;
+  * delay/reorder faults (rafttest/network.go:122-144 delay semantics):
+    messages divert into a held buffer with probability p and deliver a
+    round late — arriving after younger messages, which exercises
+    reordering;
+  * checkers, evaluated every round as tensor reductions and accumulated
+    as violation counters so only a handful of scalars ever cross to the
+    host:
+      - election safety: at most one leader per (group, term);
+      - state-machine safety (KV_HASH): equal applied index => equal
+        applied hash, for every member pair;
+      - commit monotonicity: no node's commit index ever regresses.
+
+Everything (fault sampling, stepping, checking) lives in one lax.scan —
+no host round-trips during a chaos epoch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
+from etcd_tpu.models.state import NodeState
+from etcd_tpu.types import Msg, ROLE_LEADER, Spec
+from etcd_tpu.utils.config import RaftConfig
+
+
+class Violations(struct.PyTreeNode):
+    """Safety-violation counters (i32 scalars)."""
+
+    multi_leader: jnp.ndarray     # >1 leader at one (group, term)
+    hash_mismatch: jnp.ndarray    # equal applied, different hash
+    commit_regress: jnp.ndarray   # commit index moved backwards
+
+
+def zero_violations() -> Violations:
+    z = jnp.int32(0)
+    return Violations(multi_leader=z, hash_mismatch=z, commit_regress=z)
+
+
+def check_invariants(state: NodeState, prev_commit: jnp.ndarray,
+                     viol: Violations) -> Violations:
+    """One round's checker pass: pure reductions over [M, C] leaves."""
+    M = state.role.shape[0]
+    is_lead = state.role == ROLE_LEADER            # [M, C]
+    term = state.term
+    # pairwise i<j comparisons over the tiny member axis
+    iu, ju = jnp.triu_indices(M, k=1)
+    both_lead = is_lead[iu] & is_lead[ju] & (term[iu] == term[ju])
+    same_applied = state.applied[iu] == state.applied[ju]
+    diff_hash = state.applied_hash[iu] != state.applied_hash[ju]
+    regress = state.commit < prev_commit
+    return Violations(
+        multi_leader=viol.multi_leader + both_lead.sum().astype(jnp.int32),
+        hash_mismatch=viol.hash_mismatch
+        + (same_applied & diff_hash).sum().astype(jnp.int32),
+        commit_regress=viol.commit_regress + regress.sum().astype(jnp.int32),
+    )
+
+
+def _merge_delayed(out: Msg, held: Msg, delay_mask) -> tuple[Msg, Msg]:
+    """Split this round's traffic by the delay mask and merge in messages
+    held from the previous round. A held message wins a slot collision
+    (the fresh one drops — legal per the transport contract,
+    etcdserver/raft.go:107-110)."""
+    dm = delay_mask  # [to, from, K, C] bool
+    new_held = jax.tree.map(
+        lambda x: jnp.where(_bc(dm, x), x, jnp.zeros_like(x)), out
+    )
+    new_held = new_held.replace(type=jnp.where(dm, out.type, 0))
+    fresh = out.replace(type=jnp.where(dm, 0, out.type))
+    held_live = held.type != 0
+    merged = jax.tree.map(
+        lambda h, f: jnp.where(_bc(held_live, h), h, f), held, fresh
+    )
+    merged = merged.replace(
+        type=jnp.where(held_live, held.type, fresh.type)
+    )
+    return merged, new_held
+
+
+def _bc(mask, leaf):
+    """Broadcast a [to, from, K, C] mask onto a message leaf that may have
+    an extra E axis before C."""
+    if leaf.ndim == mask.ndim + 1:
+        return mask[:, :, :, None, :]
+    return mask
+
+
+def build_chaos_epoch(
+    cfg: RaftConfig,
+    spec: Spec,
+    rounds: int,
+    drop_p: float = 0.02,
+    delay_p: float = 0.05,
+    partition_p: float = 0.1,
+    partition_period: int = 25,
+    tick: bool = True,
+):
+    """One jitted chaos epoch: `rounds` lockstep rounds of faulted traffic
+    with per-round invariant checks.
+
+    Returns fn(state, inbox, held, key, prop_len, prop_data, viol,
+    prev_commit) -> (state, inbox, held, key, viol, commits_delta).
+
+    Partitions re-sample every `partition_period` rounds: each group is
+    partitioned with probability partition_p into two random sides (links
+    across sides drop entirely); other faults stack on top.
+    """
+    round_fn = build_round(cfg, spec)
+    M = spec.M
+
+    def epoch(state, inbox, held, key, prop_len, prop_data, viol,
+              prev_commit):
+        C = state.term.shape[-1]
+        zp = jnp.zeros((M, spec.E, C), jnp.int32)
+        z2 = jnp.zeros((M, C), jnp.int32)
+        no = jnp.zeros((M, C), jnp.bool_)
+        do_tick = jnp.full((M, C), tick, jnp.bool_)
+        commit0 = state.commit.sum()
+        key, pkey = jax.random.split(key)
+
+        def body(carry, r):
+            state, inbox, held, key, viol, prev_commit = carry
+            key, kd, kl = jax.random.split(key, 3)
+            # rolling partition: drawn from the epoch-stable pkey folded
+            # with the period index, so the cut holds for a whole period
+            # and re-rolls at the next one
+            period = r // partition_period
+            kp = jax.random.fold_in(pkey, period)
+            side = jax.random.bernoulli(kp, 0.5, (M, C))
+            partitioned = jax.random.bernoulli(
+                jax.random.fold_in(kp, 1), partition_p, (C,)
+            )
+            same_side = side[:, None, :] == side[None, :, :]  # [M, M, C]
+            keep_part = same_side | ~partitioned[None, None, :]
+            keep_drop = jax.random.bernoulli(kd, 1.0 - drop_p, (M, M, C))
+            keep = keep_part & keep_drop
+
+            state, out = round_fn(
+                state, inbox, prop_len, prop_data, zp, z2, no, do_tick, keep
+            )
+            delay = jax.random.bernoulli(
+                kl, delay_p, (M, M, spec.K, C)
+            ) & (out.type != 0)
+            nxt, held2 = _merge_delayed(out, held, delay)
+            viol = check_invariants(state, prev_commit, viol)
+            return (state, nxt, held2, key, viol, state.commit), None
+
+        (state, inbox, held, key, viol, prev_commit), _ = jax.lax.scan(
+            body, (state, inbox, held, key, viol, prev_commit),
+            jnp.arange(rounds, dtype=jnp.int32),
+        )
+        return state, inbox, held, key, viol, state.commit.sum() - commit0
+
+    return epoch
+
+
+def run_chaos(
+    spec: Spec,
+    cfg: RaftConfig,
+    C: int,
+    rounds: int = 200,
+    epoch_len: int = 50,
+    heal_len: int = 25,
+    seed: int = 0,
+    drop_p: float = 0.02,
+    delay_p: float = 0.05,
+    partition_p: float = 0.1,
+    propose: bool = True,
+) -> dict:
+    """The tester's round loop (tester/cluster_run.go): alternate fault
+    epochs and heal epochs, then verify recovery — every group ends with
+    a leader and fresh commits. Returns the violation counts + liveness
+    stats; raises nothing (the caller asserts)."""
+    state = init_fleet(spec, C, election_tick=cfg.election_tick, seed=seed)
+    inbox = empty_inbox(spec, C)
+    held = jax.tree.map(jnp.zeros_like, inbox)
+    key = jax.random.PRNGKey(seed)
+    M = spec.M
+    prop_len = jnp.zeros((M, C), jnp.int32)
+    prop_data = jnp.zeros((M, spec.E, C), jnp.int32)
+    if propose:
+        # one proposal per group per round at node 0; when node 0 is not
+        # the leader the proposal forwards to it (stepFollower MsgProp),
+        # so stress keeps flowing wherever leadership lands
+        prop_len = prop_len.at[0].set(1)
+        prop_data = prop_data.at[0, 0].set(7)
+
+    chaos = jax.jit(build_chaos_epoch(
+        cfg, spec, epoch_len, drop_p, delay_p, partition_p
+    ))
+    heal = jax.jit(build_chaos_epoch(cfg, spec, heal_len, 0.0, 0.0, 0.0))
+
+    viol = zero_violations()
+    prev_commit = state.commit
+    commits = []
+    done = 0
+    while done < rounds:
+        state, inbox, held, key, viol, dc = chaos(
+            state, inbox, held, key, prop_len, prop_data, viol, prev_commit
+        )
+        prev_commit = state.commit
+        done += epoch_len
+        state, inbox, held, key, viol, dh = heal(
+            state, inbox, held, key, prop_len, prop_data, viol, prev_commit
+        )
+        prev_commit = state.commit
+        done += heal_len
+        commits.append((int(dc), int(dh)))
+
+    # recovery check (the tester's WaitHealth loop, tester/cluster.go):
+    # keep healing in bounded increments until every group has a leader —
+    # a group whose randomized election timeout just fired may need more
+    # than one heal epoch to converge
+    def leaders() -> int:
+        return int(((state.role == ROLE_LEADER).sum(axis=0) > 0).sum())
+
+    for _ in range(6):
+        if leaders() == C:
+            break
+        state, inbox, held, key, viol, dh = heal(
+            state, inbox, held, key, prop_len, prop_data, viol, prev_commit
+        )
+        prev_commit = state.commit
+        done += heal_len
+        commits.append((0, int(dh)))
+    has_leader = leaders()
+    v = jax.device_get(viol)
+    return {
+        "groups": C,
+        "rounds": done,
+        "multi_leader": int(v.multi_leader),
+        "hash_mismatch": int(v.hash_mismatch),
+        "commit_regress": int(v.commit_regress),
+        "groups_with_leader_after_heal": has_leader,
+        "heal_commits_last_epoch": commits[-1][1],
+        "epoch_commits": commits,
+    }

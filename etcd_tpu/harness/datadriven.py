@@ -39,6 +39,13 @@ _ARG_RE = re.compile(r"(\w+)=\(([^)]*)\)|(\w+)=(\S+)")
 def parse_directive(line: str) -> tuple[str, dict[str, list[str]]]:
     cmd, _, rest = line.partition(" ")
     args: dict[str, list[str]] = {}
+    # collect bare positional tokens ("campaign 1", "stabilize 1 4") from
+    # the directive with parenthesized kwarg values masked out first, so
+    # 'drop=(2, 3)' doesn't leak '3)' into the positional list
+    bare = re.sub(r"\w+=\([^)]*\)", "", rest)
+    for tok in bare.split():
+        if "=" not in tok:
+            args.setdefault("_pos", []).append(tok)
     for m in _ARG_RE.finditer(rest):
         if m.group(1) is not None:
             key, raw = m.group(1), m.group(2)

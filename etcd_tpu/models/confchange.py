@@ -27,6 +27,7 @@ from etcd_tpu.types import (
     CC_ADD_NODE,
     CC_REMOVE_NODE,
     NONE_ID,
+    PR_PROBE,
     ROLE_LEADER,
 )
 from etcd_tpu.utils.tree import tree_where
@@ -121,6 +122,8 @@ def apply_conf_change(cfg, spec, n, ob, data, enable):
         spec, v_c, vo_c, l_c, ln_c, joint_now, op2, id2, do_change & has2
     )
 
+    was_tracked = v | vo | l | ln_
+
     n = n.replace(
         voters=jnp.where(do_leave, v_l, jnp.where(do_change, v_c, n.voters)),
         voters_out=jnp.where(do_leave, vo_l, jnp.where(do_change, vo_c, n.voters_out)),
@@ -131,6 +134,27 @@ def apply_conf_change(cfg, spec, n, ob, data, enable):
         auto_leave=jnp.where(
             do_leave, False, jnp.where(do_change & enter, auto, n.auto_leave)
         ),
+    )
+
+    # Fresh Progress for members entering the tracked set
+    # (confchange.go:249-272 initProgress): match=0, next=lastIndex (so the
+    # new follower can be probed immediately), probe state, recently active
+    # so CheckQuorum doesn't step the leader down before first contact.
+    # Without this a removed-then-re-added member would keep its stale
+    # match, which could falsely advance the commit index.
+    now_tracked = n.voters | n.voters_out | n.learners | n.learners_next
+    fresh = enable & now_tracked & ~was_tracked
+    zM = jnp.zeros((spec.M,), jnp.int32)
+    n = n.replace(
+        match=jnp.where(fresh, 0, n.match),
+        next_idx=jnp.where(fresh, jnp.maximum(n.last_index, 1), n.next_idx),
+        pr_state=jnp.where(fresh, PR_PROBE, n.pr_state),
+        probe_sent=jnp.where(fresh, False, n.probe_sent),
+        pending_snapshot=jnp.where(fresh, 0, n.pending_snapshot),
+        recent_active=jnp.where(fresh, True, n.recent_active),
+        infl_count=jnp.where(fresh, 0, n.infl_count),
+        infl_start=jnp.where(fresh, 0, n.infl_start),
+        infl_ends=jnp.where(fresh[:, None], zM[:, None], n.infl_ends),
     )
 
     # switchToConfig side effects (raft.go:1651-1700)

@@ -75,7 +75,7 @@ def init_fleet(
     )(jnp.arange(spec.M, dtype=jnp.int32))
 
 
-def build_round(cfg: RaftConfig, spec: Spec):
+def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
     """Returns round_fn(state, inbox, prop_len, prop_data, prop_type,
     ri_ctx, do_hup, do_tick, keep_mask) -> (state, next_inbox).
 
@@ -83,6 +83,9 @@ def build_round(cfg: RaftConfig, spec: Spec):
     [M(to), M(from), K, (E,) C]; prop_len/ri_ctx/do_hup/do_tick [M, C];
     prop_data/prop_type [M, E, C]; keep_mask [M(from), M(to), C] bool
     (True = deliver).
+
+    with_drop_count: also return the number of emitted messages the
+    keep-mask killed this round (for the metrics pipeline).
     """
     node_fn = functools.partial(node_round, cfg, spec)
     # outer vmap: member axis (leading); inner vmap: cluster axis (minor)
@@ -105,8 +108,12 @@ def build_round(cfg: RaftConfig, spec: Spec):
         msgs = ob.msgs  # leaves [from, to, K, (E,) C]
         # self-loops (MsgHup-to-self etc.) are local, never subject to faults
         keep = keep_mask | jnp.eye(spec.M, dtype=jnp.bool_)[:, :, None]
+        emitted = (msgs.type != 0).sum() if with_drop_count else None
         msgs = msgs.replace(type=jnp.where(keep[:, :, None, :], msgs.type, 0))
         next_inbox = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), msgs)
+        if with_drop_count:
+            dropped = emitted - (next_inbox.type != 0).sum()
+            return state, next_inbox, dropped
         return state, next_inbox
 
     return round_fn

@@ -1,0 +1,235 @@
+"""Fleet observability: on-device metrics + status snapshots.
+
+The reference instruments everything with Prometheus counters
+(server/etcdserver/metrics.go — proposals committed/applied/pending,
+leader changes, heartbeat failures) and exports per-node Status snapshots
+(raft/status.go:26-76). A batched fleet cannot afford a host read per
+group per round, so the TPU-native design keeps a small
+:class:`FleetMetrics` pytree ON DEVICE, updated by pure tensor reductions
+fused into the round program; the host reads a handful of scalars
+whenever it wants a report (one tiny transfer, no sync in the hot loop).
+
+Status comes in two granularities:
+  * :func:`fleet_summary` — whole-fleet aggregates (roles histogram,
+    term/commit spread, commit-apply lag) from one device reduction.
+  * :func:`basic_status` — one group's per-node Status dict, the analog
+    of raft.Status for lane (m, c).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from etcd_tpu.models.engine import build_round
+from etcd_tpu.models.state import NodeState
+from etcd_tpu.types import (
+    NONE_ID,
+    PR_PROBE,
+    PR_REPLICATE,
+    PR_SNAPSHOT,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import RaftConfig
+
+# commit-apply lag histogram bucket upper bounds (entries); last is +inf
+LAG_BUCKETS = (0, 1, 2, 4, 8, 16, 32)
+
+
+class FleetMetrics(struct.PyTreeNode):
+    """Device-resident counters.
+
+    Counters are i32 under the default JAX config (i64 only with
+    jax_enable_x64): reset per measurement window (``zero_metrics()``)
+    rather than accumulating for a whole soak — at 1M groups the message
+    counter crosses 2^31 after ~100 rounds. ``metrics_report`` raises if
+    a counter has wrapped.
+    """
+
+    rounds: jnp.ndarray          # lockstep rounds executed
+    elections_won: jnp.ndarray   # nodes that newly became leader
+    leader_losses: jnp.ndarray   # nodes that stopped being leader
+    commits: jnp.ndarray         # sum of per-node commit advances
+    applies: jnp.ndarray         # sum of per-node applied advances
+    msgs_sent: jnp.ndarray       # outbox slots emitted (pre fault-mask)
+    msgs_dropped: jnp.ndarray    # emitted slots killed by the keep-mask
+    lag_hist: jnp.ndarray        # [len(LAG_BUCKETS)+1] cumulative lag counts
+
+
+def zero_metrics() -> FleetMetrics:
+    z = jnp.int64(0) if jax.config.jax_enable_x64 else jnp.int32(0)
+    return FleetMetrics(
+        rounds=z, elections_won=z, leader_losses=z, commits=z, applies=z,
+        msgs_sent=z, msgs_dropped=z,
+        lag_hist=jnp.zeros((len(LAG_BUCKETS) + 1,), z.dtype),
+    )
+
+
+def build_metered_round(cfg: RaftConfig, spec: Spec):
+    """Round program with fused metric updates.
+
+    Returns fn(state, inbox, prop_len, prop_data, prop_type, ri_ctx,
+    do_hup, do_tick, keep_mask, metrics) -> (state, inbox, metrics).
+
+    The metric math is a handful of elementwise reductions over state
+    the round already touches — XLA fuses them into the same program, so
+    the marginal cost is one small add per counter.
+    """
+    round_fn = build_round(cfg, spec, with_drop_count=True)
+
+    def metered(state: NodeState, inbox, prop_len, prop_data, prop_type,
+                ri_ctx, do_hup, do_tick, keep_mask, metrics: FleetMetrics):
+        was_leader = state.role == ROLE_LEADER
+        commit0, applied0 = state.commit, state.applied
+        state, next_inbox, dropped = round_fn(
+            state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup,
+            do_tick, keep_mask,
+        )
+        is_leader = state.role == ROLE_LEADER
+        dt = metrics.rounds.dtype
+        delivered = (next_inbox.type != 0).sum().astype(dt)
+        lag = (state.commit - state.applied).astype(jnp.int32)
+        edges = jnp.asarray(LAG_BUCKETS, jnp.int32)
+        # Prometheus-style cumulative buckets: hist[b] counts lag <=
+        # edges[b]; the final slot counts every sample (+inf bucket)
+        cum = (lag[..., None] <= edges).sum(axis=tuple(range(lag.ndim)))
+        total = jnp.asarray(lag.size, cum.dtype)
+        hist = jnp.concatenate([cum, total[None]]).astype(dt)
+        metrics = FleetMetrics(
+            rounds=metrics.rounds + 1,
+            elections_won=metrics.elections_won
+            + (is_leader & ~was_leader).sum().astype(dt),
+            leader_losses=metrics.leader_losses
+            + (was_leader & ~is_leader).sum().astype(dt),
+            commits=metrics.commits
+            + (state.commit - commit0).sum().astype(dt),
+            applies=metrics.applies
+            + (state.applied - applied0).sum().astype(dt),
+            msgs_sent=metrics.msgs_sent + delivered,
+            msgs_dropped=metrics.msgs_dropped + dropped.astype(dt),
+            lag_hist=metrics.lag_hist + hist,
+        )
+        return state, next_inbox, metrics
+
+    return metered
+
+
+def metrics_report(metrics: FleetMetrics, elapsed_s: float | None = None,
+                   n_groups: int | None = None,
+                   n_members: int | None = None) -> dict:
+    """One host transfer -> a plain dict (the /metrics endpoint analog)."""
+    m = jax.device_get(metrics)
+    if int(m.msgs_sent) < 0 or int(m.commits) < 0 or int(m.applies) < 0:
+        raise OverflowError(
+            "FleetMetrics counter wrapped (i32); reset metrics per window "
+            "with zero_metrics()"
+        )
+    out = {
+        "rounds": int(m.rounds),
+        "elections_won": int(m.elections_won),
+        "leader_losses": int(m.leader_losses),
+        "commits_total": int(m.commits),
+        "applies_total": int(m.applies),
+        "msgs_delivered": int(m.msgs_sent),
+        "msgs_dropped": int(m.msgs_dropped),
+        "commit_apply_lag_hist": {
+            **{f"le_{b}": int(v) for b, v in zip(LAG_BUCKETS, m.lag_hist)},
+            "inf": int(m.lag_hist[-1]),
+        },
+    }
+    if elapsed_s and elapsed_s > 0:
+        out["commits_per_sec"] = round(int(m.commits) / elapsed_s, 1)
+        out["rounds_per_sec"] = round(int(m.rounds) / elapsed_s, 1)
+    if n_groups:
+        # `commits` sums per-REPLICA commit-cursor advances; normalizing
+        # by the replica count gives committed entries per group per round
+        nodes = n_groups * (n_members or 1)
+        key = (
+            "commits_per_group_per_round" if n_members
+            else "commit_advances_per_node_per_round"
+        )
+        out[key] = round(int(m.commits) / max(int(m.rounds), 1) / nodes, 4)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# status snapshots (raft/status.go:26-76)
+# ---------------------------------------------------------------------------
+
+_ROLE_NAMES = {0: "StateFollower", 1: "StatePreCandidate",
+               2: "StateCandidate", 3: "StateLeader"}
+_PR_NAMES = {PR_PROBE: "StateProbe", PR_REPLICATE: "StateReplicate",
+             PR_SNAPSHOT: "StateSnapshot"}
+
+
+def fleet_summary(state: NodeState) -> dict:
+    """Whole-fleet aggregate status: one jitted reduction, one transfer."""
+
+    @jax.jit
+    def agg(s: NodeState):
+        roles = jnp.stack([(s.role == r).sum() for r in range(4)])
+        lag = s.commit - s.applied
+        per_group_leaders = (s.role == ROLE_LEADER).sum(axis=0)
+        return dict(
+            roles=roles,
+            term_max=s.term.max(),
+            commit_min=s.commit.min(), commit_max=s.commit.max(),
+            lag_max=lag.max(), lag_sum=lag.sum(),
+            groups_with_leader=(per_group_leaders > 0).sum(),
+            groups_multi_leader=(per_group_leaders > 1).sum(),
+        )
+
+    r = jax.device_get(agg(state))
+    M, C = state.role.shape[0], state.role.shape[-1]
+    return {
+        "nodes": int(M * C),
+        "groups": int(C),
+        "roles": {
+            name: int(r["roles"][i]) for i, name in _ROLE_NAMES.items()
+        },
+        "term_max": int(r["term_max"]),
+        "commit_min": int(r["commit_min"]),
+        "commit_max": int(r["commit_max"]),
+        "commit_apply_lag_max": int(r["lag_max"]),
+        "commit_apply_lag_mean": float(r["lag_sum"]) / (M * C),
+        "groups_with_leader": int(r["groups_with_leader"]),
+        "groups_multi_leader": int(r["groups_multi_leader"]),
+    }
+
+
+def basic_status(state: NodeState, spec: Spec, m: int, c: int = 0) -> dict:
+    """raft.Status for one lane (m, c) of the fleet: BasicStatus fields
+    plus the leader's progress map (status.go:26-76)."""
+    g = lambda leaf: np.asarray(leaf[m, ..., c])
+    role = int(g(state.role))
+    out = {
+        "id": m,
+        "term": int(g(state.term)),
+        "vote": int(g(state.vote)),
+        "commit": int(g(state.commit)),
+        "applied": int(g(state.applied)),
+        "lead": int(g(state.lead)),
+        "raft_state": _ROLE_NAMES[role],
+    }
+    if role == ROLE_LEADER:
+        tracked = g(state.voters) | g(state.voters_out) | g(state.learners) \
+            | g(state.learners_next)
+        match, nxt = g(state.match), g(state.next_idx)
+        prs, ract = g(state.pr_state), g(state.recent_active)
+        psnap, icnt = g(state.pending_snapshot), g(state.infl_count)
+        lrn = g(state.learners) | g(state.learners_next)
+        out["progress"] = {
+            int(i): {
+                "match": int(match[i]),
+                "next": int(nxt[i]),
+                "state": _PR_NAMES[int(prs[i])],
+                "is_learner": bool(lrn[i]),
+                "recent_active": bool(ract[i]),
+                "pending_snapshot": int(psnap[i]),
+                "inflight": int(icnt[i]),
+            }
+            for i in range(spec.M) if tracked[i]
+        }
+    return out

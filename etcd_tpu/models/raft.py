@@ -358,25 +358,32 @@ def maybe_send_append(
     )
     n = infl.add(spec, n, has_ents & repl, last_sent)
 
+    # The snapshot sent is the freshest applied state — index `applied`,
+    # the rolling applied hash, and the applied config — not the last
+    # compaction point. This mirrors the reference harness's "you get the
+    # most recent snapshot" semantics (rafttest's snapshotOverride,
+    # interaction_env_handler_add_nodes.go:39-58) and catches the
+    # follower up as far as possible in one message.
+    t_app, _ = logops.term_at(spec, n, n.applied)
     snap = bcast(spec, base).replace(
         type=jnp.where(send_snap, MSG_SNAP, MSG_NONE),
         term=jnp.broadcast_to(n.term, (spec.M,)),
         frm=jnp.broadcast_to(n.nid, (spec.M,)),
-        index=jnp.broadcast_to(n.snap_index, (spec.M,)),
-        log_term=jnp.broadcast_to(n.snap_term, (spec.M,)),
-        commit=jnp.broadcast_to(n.snap_hash, (spec.M,)),
-        reject=jnp.broadcast_to(n.snap_auto_leave, (spec.M,)),
-        c_voters=jnp.broadcast_to(pack_mask(n.snap_voters), (spec.M,)),
-        c_voters_out=jnp.broadcast_to(pack_mask(n.snap_voters_out), (spec.M,)),
-        c_learners=jnp.broadcast_to(pack_mask(n.snap_learners), (spec.M,)),
+        index=jnp.broadcast_to(n.applied, (spec.M,)),
+        log_term=jnp.broadcast_to(t_app, (spec.M,)),
+        commit=jnp.broadcast_to(n.applied_hash, (spec.M,)),
+        reject=jnp.broadcast_to(n.auto_leave, (spec.M,)),
+        c_voters=jnp.broadcast_to(pack_mask(n.voters), (spec.M,)),
+        c_voters_out=jnp.broadcast_to(pack_mask(n.voters_out), (spec.M,)),
+        c_learners=jnp.broadcast_to(pack_mask(n.learners), (spec.M,)),
         c_learners_next=jnp.broadcast_to(
-            pack_mask(n.snap_learners_next), (spec.M,)
+            pack_mask(n.learners_next), (spec.M,)
         ),
     )
     ob = emit(spec, ob, send_snap, snap)
     n = n.replace(
         pr_state=jnp.where(send_snap, PR_SNAPSHOT, n.pr_state),
-        pending_snapshot=jnp.where(send_snap, n.snap_index, n.pending_snapshot),
+        pending_snapshot=jnp.where(send_snap, n.applied, n.pending_snapshot),
     )
     return n, ob
 
@@ -1262,7 +1269,9 @@ def apply_round(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox):
     n = n.replace(
         pending_conf_index=jnp.where(al & acc, n.last_index, n.pending_conf_index)
     )
-    n, ob = bcast_append(cfg, spec, n, ob, al & acc)
+    # NB: append only — no immediate bcast. The reference's advance()
+    # (raft.go:554-570) appends the leave entry without broadcasting;
+    # followers pick it up from the next triggered send.
 
     # compaction: snapshot at the applied cursor when the ring is nearly full
     occ = n.last_index - n.snap_index

@@ -1,0 +1,740 @@
+"""RawNode: the synchronous per-group driver contract over device kernels.
+
+The reference's ``RawNode`` (raft/rawnode.go:34-241) is the thread-unsafe
+API every etcd server drives: mutate the state machine via Campaign /
+Propose / Step / Tick, then harvest pending work as an immutable ``Ready``
+batch (raft/node.go:52-90), persist/send/apply it, and ``Advance``. This
+module provides the same contract backed by the TPU engine's kernels: a
+RawNode owns one *lane* of the fleet — a single-node :class:`NodeState`
+pytree stepped by the very same ``process_message`` / ``tick_timers`` /
+``apply_round`` functions that ``node_round`` fuses for the batched fleet
+(etcd_tpu/models/raft.py), jitted here at batch=1.
+
+Ready/Advance accounting mirrors rawnode.go:125-179: prev Soft/HardState
+are remembered at Ready() (acceptReady) and committed at Advance();
+MustSync follows node.go:586-593 (term or vote changed, or new entries).
+
+Differences from the reference (deliberate):
+  * Snapshots restore eagerly inside the step (see
+    models/raft.py handle_snapshot); Ready still surfaces the snapshot so
+    the application can persist it, but the in-memory log has already
+    adopted it.
+  * Conf changes are applied by the engine at apply time (inside
+    ``apply_round``) rather than via an explicit ApplyConfChange call;
+    Advance() therefore both advances the applied cursor and performs the
+    config switch, and `last_conf_states` reports switches for drivers
+    that want the reference's return value.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from etcd_tpu.models import raft as raftmod
+from etcd_tpu.models.state import NodeState, init_node
+from etcd_tpu.ops import log as logops
+from etcd_tpu.ops.outbox import Outbox, empty_outbox, make_msg
+from etcd_tpu.storage.raftstorage import (
+    ConfState,
+    Entry,
+    HardState,
+    Snapshot,
+    SnapshotMeta,
+    Storage,
+)
+from etcd_tpu.types import (
+    CAMPAIGN_NONE,
+    ENTRY_CONF_CHANGE,
+    ENTRY_NORMAL,
+    MSG_HUP,
+    MSG_NONE,
+    MSG_PROP,
+    MSG_SNAP,
+    NONE_ID,
+    PR_PROBE,
+    PR_REPLICATE,
+    PR_SNAPSHOT,
+    ROLE_CANDIDATE,
+    ROLE_FOLLOWER,
+    ROLE_LEADER,
+    ROLE_PRE_CANDIDATE,
+    Msg,
+    Spec,
+    pack_mask,
+)
+from etcd_tpu.utils.config import RaftConfig
+
+ROLE_NAMES = {
+    ROLE_FOLLOWER: "StateFollower",
+    ROLE_PRE_CANDIDATE: "StatePreCandidate",
+    ROLE_CANDIDATE: "StateCandidate",
+    ROLE_LEADER: "StateLeader",
+}
+
+# IsResponseMsg (raft/util.go:47-50)
+_RESPONSE_TYPES = {
+    2, 4, 7, 9, 15,  # AppResp, VoteResp, HeartbeatResp, PreVoteResp, Unreachable
+}
+
+
+class ErrStepLocalMsg(Exception):
+    """raft: cannot step raft local message (rawnode.go:70-72)."""
+
+
+class ErrStepPeerNotFound(Exception):
+    """raft: cannot step as peer not found (rawnode.go:74-78)."""
+PR_NAMES = {PR_PROBE: "StateProbe", PR_REPLICATE: "StateReplicate",
+            PR_SNAPSHOT: "StateSnapshot"}
+
+
+@dataclasses.dataclass
+class HostMsg:
+    """Host-side message record (raftpb.Message analog with explicit to)."""
+
+    type: int
+    to: int
+    frm: int
+    term: int = 0
+    index: int = 0
+    log_term: int = 0
+    commit: int = 0
+    reject: bool = False
+    reject_hint: int = 0
+    context: int = 0
+    entries: tuple[Entry, ...] = ()
+    snapshot: Snapshot | None = None  # MsgSnap only
+
+
+@dataclasses.dataclass
+class SoftState:
+    lead: int
+    role: int  # ROLE_*
+
+
+@dataclasses.dataclass
+class ReadState:
+    index: int
+    request_ctx: int
+
+
+@dataclasses.dataclass
+class Ready:
+    """The pending-work batch (raft/node.go:52-90)."""
+
+    soft_state: SoftState | None = None
+    hard_state: HardState | None = None  # None == unchanged (empty)
+    read_states: list[ReadState] = dataclasses.field(default_factory=list)
+    entries: list[Entry] = dataclasses.field(default_factory=list)
+    snapshot: Snapshot | None = None
+    committed_entries: list[Entry] = dataclasses.field(default_factory=list)
+    messages: list[HostMsg] = dataclasses.field(default_factory=list)
+    must_sync: bool = False
+
+    # Advance bookkeeping (acceptReady cursors)
+    _commit_bound: int = 0
+
+
+@dataclasses.dataclass
+class Progress:
+    """tracker.Progress snapshot (tracker/progress.go:30-80)."""
+
+    match: int
+    next: int
+    state: int  # PR_*
+    is_learner: bool
+    paused: bool
+    pending_snapshot: int
+    recent_active: bool
+    inflight: int
+    inflight_full: bool
+
+    def __str__(self) -> str:
+        out = f"{PR_NAMES[self.state]} match={self.match} next={self.next}"
+        if self.is_learner:
+            out += " learner"
+        if self.paused:
+            out += " paused"
+        if self.pending_snapshot > 0:
+            out += f" pendingSnap={self.pending_snapshot}"
+        if not self.recent_active:
+            out += " inactive"
+        if self.inflight > 0:
+            out += f" inflight={self.inflight}"
+            if self.inflight_full:
+                out += "[full]"
+        return out
+
+
+@dataclasses.dataclass
+class Status:
+    """raft.Status/BasicStatus (raft/status.go:26-76)."""
+
+    id: int
+    hard_state: HardState
+    soft_state: SoftState
+    applied: int
+    progress: dict[int, Progress]
+    conf_state: ConfState
+
+
+@functools.lru_cache(maxsize=32)
+def _kernels(cfg: RaftConfig, spec: Spec):
+    """Jitted single-lane kernels shared by every RawNode with this
+    (cfg, spec)."""
+
+    def step_msg(n: NodeState, m: Msg):
+        ob = empty_outbox(spec)
+        return raftmod.process_message(cfg, spec, n, ob, m)
+
+    def tick(n: NodeState):
+        ob = empty_outbox(spec)
+        n, ob, fire = raftmod.tick_timers(cfg, spec, n, ob, jnp.bool_(True))
+        # tickElection runs the campaign synchronously (raft.go:645-654)
+        hup = make_msg(spec, frm=n.nid).replace(
+            type=jnp.where(fire, MSG_HUP, MSG_NONE),
+            context=jnp.int32(CAMPAIGN_NONE),
+        )
+        n, ob = raftmod.process_message(cfg, spec, n, ob, hup)
+        return n, ob
+
+    def apply_some(n: NodeState):
+        ob = empty_outbox(spec)
+        return raftmod.apply_round(cfg, spec, n, ob)
+
+    return jax.jit(step_msg), jax.jit(tick), jax.jit(apply_some)
+
+
+def host_to_device_msg(spec: Spec, hm: HostMsg) -> Msg:
+    """HostMsg -> device Msg (the inbox slot format, etcd_tpu/types.py)."""
+    ents = hm.entries[: spec.E]
+    eT = np.zeros((spec.E,), np.int32)
+    eD = np.zeros((spec.E,), np.int32)
+    eY = np.zeros((spec.E,), np.int32)
+    for j, e in enumerate(ents):
+        eT[j], eD[j], eY[j] = e.term, e.data, e.type
+    kw = dict(
+        type=hm.type, term=hm.term, frm=hm.frm, index=hm.index,
+        log_term=hm.log_term, commit=hm.commit, reject=hm.reject,
+        reject_hint=hm.reject_hint, context=hm.context, ent_len=len(ents),
+    )
+    if hm.snapshot is not None:
+        meta = hm.snapshot.meta
+        v, vo, l, ln_ = meta.conf_state.masks(spec.M)
+        kw.update(
+            index=meta.index, log_term=meta.term, commit=meta.app_hash,
+            reject=meta.conf_state.auto_leave,
+            c_voters=pack_mask(jnp.asarray(v)),
+            c_voters_out=pack_mask(jnp.asarray(vo)),
+            c_learners=pack_mask(jnp.asarray(l)),
+            c_learners_next=pack_mask(jnp.asarray(ln_)),
+        )
+    m = make_msg(spec, **kw)
+    return m.replace(
+        ent_term=jnp.asarray(eT), ent_data=jnp.asarray(eD),
+        ent_type=jnp.asarray(eY),
+    )
+
+
+def outbox_to_host(spec: Spec, ob: Outbox) -> list[HostMsg]:
+    """Harvest a device Outbox into HostMsgs, destination-major then slot
+    order (the reference emits per-peer in sorted-id order via
+    tracker.Visit, tracker/tracker.go:191-213, so this matches)."""
+    counts = np.asarray(ob.counts)
+    if counts.sum() == 0:
+        return []
+    get = lambda leaf: np.asarray(leaf)
+    f = {k: get(getattr(ob.msgs, k)) for k in (
+        "type", "term", "frm", "index", "log_term", "commit", "reject",
+        "reject_hint", "context", "ent_len", "ent_term", "ent_data",
+        "ent_type", "c_voters", "c_voters_out", "c_learners",
+        "c_learners_next")}
+    out: list[HostMsg] = []
+    for to in range(spec.M):
+        for k in range(int(counts[to])):
+            t = int(f["type"][to, k])
+            if t == MSG_NONE:
+                continue
+            ents: tuple[Entry, ...] = ()
+            if int(f["ent_len"][to, k]) > 0:
+                base = int(f["index"][to, k])
+                ents = tuple(
+                    Entry(
+                        index=base + 1 + j,
+                        term=int(f["ent_term"][to, k, j]),
+                        type=int(f["ent_type"][to, k, j]),
+                        data=int(f["ent_data"][to, k, j]),
+                    )
+                    for j in range(int(f["ent_len"][to, k]))
+                )
+            snap = None
+            if t == MSG_SNAP:
+                ub = lambda w: [bool((int(w) >> i) & 1) for i in range(spec.M)]
+                cs = ConfState.from_masks(
+                    ub(f["c_voters"][to, k]),
+                    ub(f["c_voters_out"][to, k]),
+                    ub(f["c_learners"][to, k]),
+                    ub(f["c_learners_next"][to, k]),
+                    bool(f["reject"][to, k]),
+                )
+                snap = Snapshot(
+                    meta=SnapshotMeta(
+                        index=int(f["index"][to, k]),
+                        term=int(f["log_term"][to, k]),
+                        conf_state=cs,
+                        app_hash=int(f["commit"][to, k]),
+                    )
+                )
+            out.append(
+                HostMsg(
+                    type=t, to=to, frm=int(f["frm"][to, k]),
+                    term=int(f["term"][to, k]),
+                    index=0 if t == MSG_SNAP else int(f["index"][to, k]),
+                    log_term=0 if t == MSG_SNAP else int(f["log_term"][to, k]),
+                    commit=0 if t == MSG_SNAP else int(f["commit"][to, k]),
+                    reject=False if t == MSG_SNAP else bool(f["reject"][to, k]),
+                    reject_hint=int(f["reject_hint"][to, k]),
+                    context=int(f["context"][to, k]),
+                    entries=ents,
+                    snapshot=snap,
+                )
+            )
+    return out
+
+
+class RawNode:
+    """Single-group driver with Ready/Advance accounting
+    (raft/rawnode.go:34-241), state stepped by the fleet kernels."""
+
+    def __init__(
+        self,
+        cfg: RaftConfig,
+        spec: Spec,
+        storage: Storage,
+        nid: int,
+        applied: int | None = None,
+        seed: int = 0,
+    ):
+        self.cfg, self.spec, self.storage = cfg, spec, storage
+        self.nid = nid
+        self._step_k, self._tick_k, self._apply_k = _kernels(cfg, spec)
+        self.n = self._boot(storage, nid, applied, seed)
+        self._pending_msgs: list[HostMsg] = []
+        self._pending_snap: Snapshot | None = None
+        self._stable_to = int(self.n.last_index)
+        # stable-entry cache: what the application has persisted so far.
+        # The device ring is truncate-and-append (maybe_append) like the
+        # reference's unstable log (log_unstable.go:121-156); when a new
+        # leader overwrites a stable suffix, Ready must re-emit it, so we
+        # diff the ring against this cache after every step.
+        self._stable_ents: dict[int, tuple[int, int, int]] = {
+            e.index: (e.term, e.type, e.data)
+            for e in self.ring_entries(
+                int(self.n.snap_index) + 1, self._stable_to + 1
+            )
+        }
+        self.prev_hs = self._hard_state()
+        self.prev_ss = self._soft_state()
+        self._rs_seen = 0
+        self.last_conf_states: list[ConfState] = []
+
+    # -- boot (newRaft, raft.go:318-370) ------------------------------------
+    def _boot(self, storage: Storage, nid, applied, seed) -> NodeState:
+        spec, cfg = self.spec, self.cfg
+        hs, cs = storage.initial_state()
+        snap = storage.snapshot()
+        v, vo, l, ln_ = cs.masks(spec.M)
+        n = init_node(
+            spec, nid, jnp.asarray(v), jnp.asarray(l), seed=seed,
+            election_tick=cfg.election_tick,
+        )
+        first, last = storage.first_index(), storage.last_index()
+        # the ring base is the storage's truncation point, which can sit
+        # past the retained snapshot (MemoryStorage.Compact moves only the
+        # offset); the device collapses both to one snapshot cursor
+        si = first - 1
+        s_term = storage.term(si) if si > 0 else 0
+        L = spec.L
+        if last - si > L:
+            raise ValueError(
+                f"storage holds {last - si} entries > ring capacity {L}"
+            )
+        lt = np.zeros((L,), np.int32)
+        ld = np.zeros((L,), np.int32)
+        ly = np.zeros((L,), np.int32)
+        for e in storage.entries(first, last + 1):
+            s = (e.index - 1) % L
+            lt[s], ld[s], ly[s] = e.term, e.data, e.type
+        applied = max(applied if applied is not None else 0, si)
+        return n.replace(
+            term=jnp.int32(hs.term),
+            vote=jnp.int32(hs.vote),
+            commit=jnp.int32(max(hs.commit, si)),
+            applied=jnp.int32(applied),
+            last_index=jnp.int32(last),
+            snap_index=jnp.int32(si),
+            snap_term=jnp.int32(s_term),
+            snap_hash=jnp.int32(snap.meta.app_hash),
+            applied_hash=jnp.int32(snap.meta.app_hash),
+            log_term=jnp.asarray(lt),
+            log_data=jnp.asarray(ld),
+            log_type=jnp.asarray(ly),
+            voters=jnp.asarray(v), voters_out=jnp.asarray(vo),
+            learners=jnp.asarray(l), learners_next=jnp.asarray(ln_),
+            auto_leave=jnp.bool_(cs.auto_leave),
+            snap_voters=jnp.asarray(v), snap_voters_out=jnp.asarray(vo),
+            snap_learners=jnp.asarray(l),
+            snap_learners_next=jnp.asarray(ln_),
+            snap_auto_leave=jnp.bool_(cs.auto_leave),
+        )
+
+    # -- state readers -------------------------------------------------------
+    def _hard_state(self) -> HardState:
+        n = self.n
+        return HardState(int(n.term), int(n.vote), int(n.commit))
+
+    def _soft_state(self) -> SoftState:
+        n = self.n
+        return SoftState(int(n.lead), int(n.role))
+
+    def ring_entries(self, lo: int, hi: int) -> list[Entry]:
+        """Entries [lo, hi) read from the device ring."""
+        n, L = self.n, self.spec.L
+        lt = np.asarray(n.log_term)
+        ld = np.asarray(n.log_data)
+        ly = np.asarray(n.log_type)
+        out = []
+        for i in range(lo, hi):
+            s = (i - 1) % L
+            out.append(Entry(index=i, term=int(lt[s]), type=int(ly[s]),
+                             data=int(ld[s])))
+        return out
+
+    # -- mutators ------------------------------------------------------------
+    def _run_msg(self, hm: HostMsg) -> None:
+        pre_snap = int(self.n.snap_index)
+        m = host_to_device_msg(self.spec, hm)
+        self.n, ob = self._step_k(self.n, m)
+        self._harvest(ob)
+        post_snap = int(self.n.snap_index)
+        if hm.type == MSG_SNAP and post_snap > pre_snap and hm.snapshot:
+            # eager restore happened: surface it in the next Ready and track
+            # the stable cursor jump (the ring was reset to the snapshot)
+            self._pending_snap = hm.snapshot
+            self._stable_to = post_snap
+            self._stable_ents = {}
+        else:
+            self._roll_back_overwritten()
+
+    def _roll_back_overwritten(self) -> None:
+        """If the step truncate-overwrote already-stable entries
+        (handleAppendEntries conflict path, models/raft.py), move the
+        stable cursor back so Ready re-emits the new suffix — the analog
+        of unstable.truncateAndAppend moving its offset down."""
+        n = self.n
+        last = int(n.last_index)
+        if last < self._stable_to:
+            self._stable_to = last
+            for j in [j for j in self._stable_ents if j > last]:
+                del self._stable_ents[j]
+        if not self._stable_ents:
+            return
+        lo = max(int(n.snap_index) + 1, min(self._stable_ents))
+        for e in self.ring_entries(lo, min(self._stable_to, last) + 1):
+            want = self._stable_ents.get(e.index)
+            if want is not None and want != (e.term, e.type, e.data):
+                self._stable_to = e.index - 1
+                for j in [j for j in self._stable_ents if j >= e.index]:
+                    del self._stable_ents[j]
+                break
+
+    def _harvest(self, ob: Outbox) -> None:
+        self._pending_msgs.extend(outbox_to_host(self.spec, ob))
+
+    def tick(self) -> None:
+        self.n, ob = self._tick_k(self.n)
+        self._harvest(ob)
+
+    def campaign(self) -> None:
+        self._run_msg(HostMsg(type=MSG_HUP, to=self.nid, frm=self.nid,
+                              context=CAMPAIGN_NONE))
+
+    def propose(self, data_word: int) -> bool:
+        """Returns False if the proposal was dropped (ErrProposalDropped)."""
+        before = (int(self.n.last_index), len(self._pending_msgs))
+        self._run_msg(
+            HostMsg(
+                type=MSG_PROP, to=self.nid, frm=self.nid,
+                entries=(Entry(index=0, term=0, type=ENTRY_NORMAL,
+                               data=data_word),),
+            )
+        )
+        return self._prop_accepted(before)
+
+    def propose_conf_change(self, cc_word: int) -> bool:
+        before = (int(self.n.last_index), len(self._pending_msgs))
+        self._run_msg(
+            HostMsg(
+                type=MSG_PROP, to=self.nid, frm=self.nid,
+                entries=(Entry(index=0, term=0, type=ENTRY_CONF_CHANGE,
+                               data=cc_word),),
+            )
+        )
+        return self._prop_accepted(before)
+
+    def _prop_accepted(self, before) -> bool:
+        last0, msgs0 = before
+        appended = int(self.n.last_index) > last0
+        forwarded = any(
+            m.type == MSG_PROP for m in self._pending_msgs[msgs0:]
+        )
+        return appended or forwarded
+
+    def step(self, hm: HostMsg) -> None:
+        """Feed an external message (Step, rawnode.go:70-79): local message
+        types are refused, and response messages from peers outside the
+        tracked progress set raise ErrStepPeerNotFound."""
+        if hm.type in (MSG_HUP, MSG_PROP):
+            raise ErrStepLocalMsg("raft: cannot step raft local message")
+        if hm.type in _RESPONSE_TYPES and 0 <= hm.frm < self.spec.M:
+            tracked = np.asarray(
+                self.n.voters | self.n.voters_out | self.n.learners
+                | self.n.learners_next
+            )
+            if not tracked[hm.frm]:
+                raise ErrStepPeerNotFound(
+                    "raft: cannot step as peer not found"
+                )
+        self._run_msg(hm)
+
+    def read_index(self, ctx: int) -> None:
+        from etcd_tpu.types import MSG_READ_INDEX
+
+        self._run_msg(HostMsg(type=MSG_READ_INDEX, to=self.nid, frm=self.nid,
+                              context=ctx))
+
+    # -- Ready/Advance (rawnode.go:125-179) ----------------------------------
+    def has_ready(self) -> bool:
+        n = self.n
+        if self._pending_msgs or self._pending_snap:
+            return True
+        if int(n.last_index) > self._stable_to:
+            return True
+        if self._hard_state() != self.prev_hs:
+            return True
+        if self._soft_state() != self.prev_ss:
+            return True
+        if int(n.commit) > int(n.applied):
+            return True
+        if int(n.rs_count) > 0:
+            return True
+        return False
+
+    def ready(self) -> Ready:
+        """Harvest pending work and accept it (Ready + acceptReady)."""
+        n = self.n
+        rd = Ready()
+        ss = self._soft_state()
+        if ss != self.prev_ss:
+            rd.soft_state = ss
+        hs = self._hard_state()
+        if hs != self.prev_hs:
+            rd.hard_state = hs
+        rs_count = int(n.rs_count)
+        if rs_count > 0:
+            ctxs = np.asarray(n.rs_ctx)[:rs_count]
+            idxs = np.asarray(n.rs_index)[:rs_count]
+            rd.read_states = [
+                ReadState(index=int(i), request_ctx=int(c))
+                for c, i in zip(ctxs, idxs)
+            ]
+            self.n = self.n.replace(rs_count=jnp.int32(0))
+        last = int(n.last_index)
+        if last > self._stable_to:
+            rd.entries = self.ring_entries(self._stable_to + 1, last + 1)
+        rd.snapshot = self._pending_snap
+        applied, commit = int(n.applied), int(n.commit)
+        if commit > applied:
+            rd.committed_entries = self.ring_entries(applied + 1, commit + 1)
+        rd.messages = self._pending_msgs
+        rd.must_sync = bool(
+            hs.term != self.prev_hs.term
+            or hs.vote != self.prev_hs.vote
+            or rd.entries
+        )
+        rd._commit_bound = commit
+        # acceptReady
+        self._pending_msgs = []
+        self._pending_snap = None
+        self.prev_ss = ss
+        self.prev_hs = hs
+        self._stable_to = last
+        for e in rd.entries:
+            self._stable_ents[e.index] = (e.term, e.type, e.data)
+        snap_i = int(n.snap_index)
+        for j in [j for j in self._stable_ents if j <= snap_i]:
+            del self._stable_ents[j]
+        return rd
+
+    def advance(self, rd: Ready) -> None:
+        """Apply the accepted committed entries; conf changes take effect
+        on-device (apply_round) and are reported via last_conf_states."""
+        self.last_conf_states = []
+        while int(self.n.applied) < rd._commit_bound:
+            pre = self._conf_tuple()
+            self.n, ob = self._apply_k(self.n)
+            self._harvest(ob)
+            post = self._conf_tuple()
+            if post != pre:
+                self.last_conf_states.append(self.conf_state())
+
+    def _conf_tuple(self):
+        n = self.n
+        return (
+            tuple(np.asarray(n.voters).tolist()),
+            tuple(np.asarray(n.voters_out).tolist()),
+            tuple(np.asarray(n.learners).tolist()),
+            tuple(np.asarray(n.learners_next).tolist()),
+        )
+
+    def compact_to(self, index: int) -> None:
+        """Advance the device lane's snapshot cursor to `index` — the lane
+        analog of MemoryStorage.Compact (raft/storage.go:208-233): entries
+        <= index become unreachable and further sends below it fall back
+        to MsgSnap (maybeSendAppend, raft.go:446-469)."""
+        n = self.n
+        if index <= int(n.snap_index):
+            return
+        if index > int(n.applied):
+            raise ValueError(
+                f"cannot compact beyond applied index {int(n.applied)}"
+            )
+        term = (
+            int(n.snap_term) if index == int(n.snap_index)
+            else self.ring_entries(index, index + 1)[0].term
+        )
+        # the applied hash at `index` equals the current hash only when
+        # applied == index; otherwise the snapshot hash stays at the last
+        # known point (the chain cannot be rewound)
+        snap_hash = (
+            int(n.applied_hash) if int(n.applied) == index
+            else int(n.snap_hash)
+        )
+        self.n = n.replace(
+            snap_index=jnp.int32(index),
+            snap_term=jnp.int32(term),
+            snap_hash=jnp.int32(snap_hash),
+            snap_voters=n.voters, snap_voters_out=n.voters_out,
+            snap_learners=n.learners, snap_learners_next=n.learners_next,
+            snap_auto_leave=n.auto_leave,
+        )
+
+    def conf_state(self) -> ConfState:
+        n = self.n
+        return ConfState.from_masks(
+            np.asarray(n.voters), np.asarray(n.voters_out),
+            np.asarray(n.learners), np.asarray(n.learners_next),
+            bool(n.auto_leave),
+        )
+
+    # -- status (raft/status.go:26-76) ---------------------------------------
+    def status(self) -> Status:
+        n, cfg, spec = self.n, self.cfg, self.spec
+        progress: dict[int, Progress] = {}
+        if int(n.role) == ROLE_LEADER:
+            match = np.asarray(n.match)
+            nxt = np.asarray(n.next_idx)
+            prs = np.asarray(n.pr_state)
+            probe_sent = np.asarray(n.probe_sent)
+            psnap = np.asarray(n.pending_snapshot)
+            ract = np.asarray(n.recent_active)
+            icnt = np.asarray(n.infl_count)
+            learners = np.asarray(n.learners | n.learners_next)
+            tracked = np.asarray(
+                n.voters | n.voters_out | n.learners | n.learners_next
+            )
+            for i in range(spec.M):
+                if not tracked[i]:
+                    continue
+                st = int(prs[i])
+                full = int(icnt[i]) >= cfg.max_inflight
+                paused = (
+                    bool(probe_sent[i]) if st == PR_PROBE
+                    else full if st == PR_REPLICATE
+                    else True
+                )
+                progress[i] = Progress(
+                    match=int(match[i]), next=int(nxt[i]), state=st,
+                    is_learner=bool(learners[i]), paused=paused,
+                    pending_snapshot=int(psnap[i]),
+                    recent_active=bool(ract[i]),
+                    inflight=int(icnt[i]), inflight_full=full,
+                )
+        return Status(
+            id=self.nid,
+            hard_state=self._hard_state(),
+            soft_state=self._soft_state(),
+            applied=int(self.n.applied),
+            progress=progress,
+            conf_state=self.conf_state(),
+        )
+
+
+class DeviceLaneStorage(Storage):
+    """Storage view over a live RawNode's device lane — what the device
+    ring itself would answer (InitialState/Entries/Term/.../Snapshot),
+    with the reference error taxonomy (raft/storage.go:24-72)."""
+
+    def __init__(self, rn: RawNode):
+        self.rn = rn
+
+    def initial_state(self):
+        return self.rn._hard_state(), self.rn.conf_state()
+
+    def first_index(self) -> int:
+        return int(self.rn.n.snap_index) + 1
+
+    def last_index(self) -> int:
+        return int(self.rn.n.last_index)
+
+    def entries(self, lo, hi, max_entries=None):
+        from etcd_tpu.storage.raftstorage import ErrCompacted, ErrUnavailable
+
+        if lo < self.first_index():
+            raise ErrCompacted(lo)
+        if hi > self.last_index() + 1:
+            raise ErrUnavailable(hi)
+        out = self.rn.ring_entries(lo, hi)
+        if max_entries is not None:
+            out = out[:max_entries]
+        return out
+
+    def term(self, i) -> int:
+        from etcd_tpu.storage.raftstorage import ErrCompacted, ErrUnavailable
+
+        n = self.rn.n
+        if i == int(n.snap_index):
+            return int(n.snap_term)
+        if i < int(n.snap_index):
+            raise ErrCompacted(i)
+        if i > int(n.last_index):
+            raise ErrUnavailable(i)
+        return self.rn.ring_entries(i, i + 1)[0].term
+
+    def snapshot(self) -> Snapshot:
+        n = self.rn.n
+        return Snapshot(
+            meta=SnapshotMeta(
+                index=int(n.snap_index), term=int(n.snap_term),
+                conf_state=ConfState.from_masks(
+                    np.asarray(n.snap_voters), np.asarray(n.snap_voters_out),
+                    np.asarray(n.snap_learners),
+                    np.asarray(n.snap_learners_next),
+                    bool(n.snap_auto_leave),
+                ),
+                app_hash=int(n.snap_hash),
+            )
+        )

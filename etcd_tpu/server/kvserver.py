@@ -101,6 +101,14 @@ class MemberState:
     # wait-map: req word -> apply result (pkg/wait analog)
     results: dict[int, Any] = dataclasses.field(default_factory=dict)
     alarms: set[str] = dataclasses.field(default_factory=set)
+    # durable backend (bbolt analog; None = memory-only member)
+    backend: Any = None
+    persisted_rev: int = 0
+    persisted_compact: int = 0
+    # consistent index actually fsync'd — the replay floor after a crash
+    durable_index: int = 0
+    crashed: bool = False  # host process down: skip apply + donor duty
+    _persist_sig: Any = None  # last persisted (applied, rev, compact)
 
 
 class EtcdCluster:
@@ -116,6 +124,7 @@ class EtcdCluster:
         c: int = 0,
         quota_bytes: int = 0,
         lease_min_ttl: int = 1,
+        data_dir: str | None = None,
     ):
         self.cl = cluster or Cluster(n_members=n_members)
         self.c = c
@@ -123,11 +132,28 @@ class EtcdCluster:
         self.quota_bytes = quota_bytes
         self.requests: dict[int, dict] = {}  # word -> request payload
         self._next_word = 1
+        self.data_dir = data_dir
+        self._gc_floor = 0  # lowest applied index with payloads retained
         self.members = [
             MemberState(WatchableStore(), Lessor(lease_min_ttl), AuthStore())
             for _ in range(self.M)
         ]
+        if data_dir:
+            import os
+
+            from etcd_tpu.storage.backend import Backend
+
+            os.makedirs(data_dir, exist_ok=True)
+            for m, ms in enumerate(self.members):
+                # fresh incarnation: any file from a previous cluster in
+                # this directory must not leak phantom revisions
+                ms.backend = Backend(self._backend_path(m), fresh=True)
         self._root_token: str | None = None
+
+    def _backend_path(self, m: int) -> str:
+        import os
+
+        return os.path.join(self.data_dir, f"member{m}.db")
 
     # ------------------------------------------------------------------ raft
     def leader(self) -> int:
@@ -194,10 +220,16 @@ class EtcdCluster:
         # material for pass 2
         gapped = []
         for m, ms in enumerate(self.members):
+            if ms.crashed:
+                continue
             hi, lo = int(applied[m]), ms.applied_index
             if hi <= lo:
                 continue
-            if int(snap[m]) > lo:
+            # a member is gapped when the ring compacted past its cursor
+            # OR the host payload table was GC'd below it (a restarted
+            # member replaying from 0): ring replay would silently skip
+            # entries — install a peer snapshot instead
+            if int(snap[m]) > lo or lo < self._gc_floor:
                 gapped.append(m)
                 continue
             apply_range(m, ms, lo, hi)
@@ -208,11 +240,112 @@ class EtcdCluster:
         # member's MVCC from its peers.
         for m in gapped:
             ms = self.members[m]
-            self._install_peer_snapshot(m, ms, int(snap[m]))
+            self._install_peer_snapshot(
+                m, ms, max(int(snap[m]), self._gc_floor)
+            )
             hi, lo = int(applied[m]), ms.applied_index
             if hi > lo:
                 apply_range(m, ms, lo, hi)
+        terms_now = np.asarray(s.term[..., c])
+        for m, ms in enumerate(self.members):
+            if ms.backend is not None and not ms.crashed:
+                self._persist(ms, int(terms_now[m]))
         self._gc_requests()
+
+    def _persist(self, ms: MemberState, term: int) -> None:
+        """Write the apply batch behind the member: new MVCC revisions +
+        one atomic applied-meta record (consistent index, cursors, lease/
+        auth/alarm snapshots) — the batchTx + cindex discipline of
+        backend/batch_tx.go + cindex/cindex.go:30-38. Flushing happens on
+        the backend's batch limit; a crash between commits rolls the
+        member back to the last committed point and WAL/ring replay
+        resumes from its consistent index."""
+        from etcd_tpu.storage import schema
+
+        kv = ms.store.kv
+        sig = (ms.applied_index, kv.current_rev, kv.compact_rev)
+        if sig == getattr(ms, "_persist_sig", None):
+            return  # nothing applied since the last persist: no-op
+        ms._persist_sig = sig
+        if kv.compact_rev > ms.persisted_compact:
+            schema.persist_compaction(ms.backend, kv)
+            ms.persisted_compact = kv.compact_rev
+        ms.persisted_rev = schema.persist_mvcc_delta(
+            ms.backend, kv, ms.persisted_rev
+        )
+        schema.save_applied_meta(
+            ms.backend,
+            index=ms.applied_index,
+            term=term,
+            store=kv,
+            lease_snap=ms.lessor.to_snapshot(),
+            auth_snap=ms.auth.to_snapshot(),
+            alarms=ms.alarms,
+        )
+        # half-full batch -> flush now so the durable floor advances and
+        # the payload table can GC (the 100ms batchInterval analog)
+        if ms.backend._pending_ops >= ms.backend.batch_limit // 2:
+            ms.backend.commit()
+        if not ms.backend._pending_ops:
+            ms.durable_index = ms.applied_index
+
+    def crash_member(self, m: int) -> None:
+        """Simulate a member process crash: all host applied state is
+        dropped; only what the backend committed survives on disk."""
+        ms = self.members[m]
+        if ms.backend is not None:
+            ms.backend._f.close()  # no commit: the pending batch is lost
+        husk = MemberState(
+            WatchableStore(), Lessor(ms.lessor.min_ttl), AuthStore()
+        )
+        husk.crashed = True
+        self.members[m] = husk
+
+    def restart_member_from_disk(self, m: int) -> None:
+        """Rebuild a member's applied state machine from its backend (the
+        bootstrapBackend path, server/etcdserver/bootstrap.go:145): MVCC
+        from the key bucket trimmed to the atomic applied-meta record,
+        lease/auth/alarms from that record, applied cursor = consistent
+        index — entries <= cindex replay as no-ops (dedup across restart,
+        server.go:1879-1885)."""
+        from etcd_tpu.storage import schema
+        from etcd_tpu.storage.backend import Backend
+
+        if self.data_dir is None:
+            # memory-only member: nothing on disk — come back empty and
+            # catch up from the ring / a peer snapshot through _pump
+            self.members[m] = MemberState(
+                WatchableStore(),
+                Lessor(self.members[m].lessor.min_ttl), AuthStore(),
+            )
+            self._pump()
+            return
+
+        be = Backend(self._backend_path(m))
+        meta = schema.load_applied_meta(be) or {
+            "consistent_index": 0, "term": 0, "current_rev": 1,
+            "compact_rev": 0, "lease": None, "auth": None, "alarms": [],
+        }
+        store = schema.load_mvcc(
+            be, max_rev=meta["current_rev"], compact_rev=meta["compact_rev"]
+        )
+        ws = WatchableStore()
+        ws.restore(store)
+        ms = MemberState(ws, Lessor(self.members[m].lessor.min_ttl),
+                         AuthStore())
+        if meta["lease"] is not None:
+            ms.lessor.restore(meta["lease"])
+        if meta["auth"] is not None:
+            ms.auth.restore(meta["auth"])
+        ms.alarms = set(meta["alarms"])
+        ms.applied_index = meta["consistent_index"]
+        ms.backend = be
+        ms.persisted_rev = store.current_rev
+        ms.persisted_compact = store.compact_rev
+        ms.durable_index = meta["consistent_index"]
+        self.members[m] = ms
+        # catch up from the device ring (or a peer snapshot if compacted)
+        self._pump()
 
     def _install_peer_snapshot(self, m: int, ms: "MemberState",
                                need: int) -> None:
@@ -222,7 +355,8 @@ class EtcdCluster:
         no peer can cover the gap — failing loudly beats silent divergence."""
         donors = [
             d for d in range(self.M)
-            if d != m and self.members[d].applied_index >= need
+            if d != m and not self.members[d].crashed
+            and self.members[d].applied_index >= need
         ]
         if not donors:
             raise ErrCorrupt(
@@ -267,9 +401,20 @@ class EtcdCluster:
             | np.asarray(s.voters_out[ref, ..., self.c])
             | np.asarray(s.learners[ref, ..., self.c])
         )
-        floor = min(
-            self.members[m].applied_index for m in range(self.M) if conf[m]
-        )
+        # The floor is what's DURABLE per member: a backend member may
+        # restart and replay everything past its last committed consistent
+        # index, so its payloads must survive until that index advances
+        # (the WAL-retained-until-snapshot contract). A crashed husk pins
+        # the floor at 0 until restart.
+        def _floor(ms: MemberState) -> int:
+            if ms.crashed:
+                return 0
+            if ms.backend is not None:
+                return min(ms.applied_index, ms.durable_index)
+            return ms.applied_index
+
+        floor = min(_floor(self.members[m]) for m in range(self.M) if conf[m])
+        self._gc_floor = max(self._gc_floor, floor)
         for word in [
             w for w, r in self.requests.items()
             if r.get("_index", 1 << 62) <= floor

@@ -1,0 +1,134 @@
+"""Durable backend + member restart tests.
+
+Covers the bbolt-analog contract (etcd_tpu/storage/backend.py:
+batched transactional appends, torn-tail recovery, defrag) and the
+WAL+backend member restart path with consistent-index dedup
+(VERDICT item 7; reference: server/storage/backend/backend.go:88-118,
+cindex/cindex.go:30-38, server.go:1879-1885 skip-if-applied).
+"""
+import os
+
+import pytest
+
+from etcd_tpu.server.kvserver import EtcdCluster
+from etcd_tpu.storage.backend import Backend
+from etcd_tpu.storage import schema
+
+
+# -- Backend contract --------------------------------------------------------
+def test_backend_put_get_persist(tmp_path):
+    p = str(tmp_path / "b.db")
+    be = Backend(p, batch_limit=4)
+    be.put("key", b"a", b"1")
+    be.put("key", b"b", b"2")
+    be.delete("key", b"a")
+    be.commit()
+    be.close()
+    be2 = Backend(p)
+    assert be2.get("key", b"a") is None
+    assert be2.get("key", b"b") == b"2"
+    assert be2.range("key", b"", b"\x00") == [(b"b", b"2")]
+
+
+def test_backend_uncommitted_batch_lost(tmp_path):
+    p = str(tmp_path / "b.db")
+    be = Backend(p, batch_limit=1000)
+    be.put("key", b"a", b"1")
+    be.commit()
+    be.put("key", b"b", b"2")  # stays in the batch buffer
+    be._f.close()  # crash without commit
+    be2 = Backend(p)
+    assert be2.get("key", b"a") == b"1"
+    assert be2.get("key", b"b") is None
+
+
+def test_backend_torn_tail_truncated(tmp_path):
+    p = str(tmp_path / "b.db")
+    be = Backend(p, batch_limit=1)
+    be.put("key", b"a", b"1")
+    be.put("key", b"b", b"2")
+    be.close()
+    good = os.path.getsize(p)
+    with open(p, "ab") as f:  # simulate a torn partial frame
+        f.write(b"\x40\x00\x00\x00\x0bgarbage")
+    be2 = Backend(p)
+    assert be2.get("key", b"a") == b"1" and be2.get("key", b"b") == b"2"
+    assert os.path.getsize(p) == good  # tail truncated at the last frame
+
+
+def test_backend_defrag_shrinks(tmp_path):
+    p = str(tmp_path / "b.db")
+    be = Backend(p, batch_limit=1)
+    for i in range(50):
+        be.put("key", b"k", b"v%d" % i)  # history accumulates
+    size_before = be.size()
+    be.defrag()
+    assert be.size() < size_before
+    assert be.get("key", b"k") == b"v49"
+    be.put("key", b"k2", b"x")  # appends still work after defrag
+    be.close()
+    be2 = Backend(p)
+    assert be2.get("key", b"k") == b"v49" and be2.get("key", b"k2") == b"x"
+
+
+# -- member restart from disk ------------------------------------------------
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    data_dir = str(tmp_path_factory.mktemp("fleet"))
+    srv = EtcdCluster(n_members=3, data_dir=data_dir)
+    srv.ensure_leader()
+    for i in range(6):
+        srv.put(b"k%d" % i, b"v%d" % i)
+    return srv
+
+
+def test_backend_tracks_applied_state(served):
+    srv = served
+    for m, ms in enumerate(srv.members):
+        assert ms.backend is not None
+        meta = schema.load_applied_meta(ms.backend)
+        assert meta["consistent_index"] == ms.applied_index
+        assert meta["current_rev"] == ms.store.kv.current_rev
+
+
+def test_member_restart_from_disk(served):
+    srv = served
+    hash_before = srv.hash_kv(0)
+    # follower 2's host process dies; its backend keeps only committed state
+    srv.crash_member(2)
+    # traffic continues while it is down
+    for i in range(4):
+        srv.put(b"down%d" % i, b"x%d" % i)
+    # restart from disk: backend state + ring replay from consistent index
+    srv.restart_member_from_disk(2)
+    srv.stabilize()
+    ms = srv.members[2]
+    assert not ms.crashed
+    assert ms.applied_index == srv.members[0].applied_index
+    # hashKV agreement across all members at the same revision: replay
+    # after restart deduplicated (no double-applied revisions)
+    h0 = srv.hash_kv(0)
+    assert srv.hash_kv(2) == h0
+    assert srv.hash_kv(1) == h0
+    assert h0 != hash_before  # traffic really advanced state
+    # the restarted member serves reads with the new data
+    resp = srv.range(b"down0", member=2, serializable=True)
+    assert resp["kvs"] and resp["kvs"][0].value == b"x0"
+
+
+def test_member_restart_sees_own_writes_only_to_cindex(served):
+    """The atomic applied-meta record governs recovery: a member whose
+    crash lost the uncommitted batch tail comes back at its consistent
+    index and replays forward (no gaps, no duplicates)."""
+    srv = served
+    srv.put(b"tail", b"t1")
+    # crash member 1 (pending batch beyond the last commit is dropped)
+    srv.crash_member(1)
+    srv.put(b"tail", b"t2")
+    srv.restart_member_from_disk(1)
+    srv.stabilize()
+    h0, h1 = srv.hash_kv(0), srv.hash_kv(1)
+    assert h0 == h1
+    resp = srv.range(b"tail", member=1, serializable=True)
+    assert resp["kvs"][0].value == b"t2"
+    assert resp["kvs"][0].version == 2

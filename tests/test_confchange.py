@@ -105,7 +105,11 @@ def test_joint_two_changes_auto_leave():
         0, cc.encode([(CC_ADD_NODE, 3), (CC_ADD_NODE, 4)], auto_leave=True)
     )
     cl.stabilize()
-    cl.stabilize()  # let the auto-leave entry propagate+commit everywhere
+    # the auto-leave entry is appended at apply time WITHOUT an immediate
+    # broadcast (advance(), raft.go:554-570) — like the reference it rides
+    # the next triggered send, so tick a heartbeat round to carry it
+    cl.stabilize(tick=True)
+    cl.stabilize(tick=True)
     for m in range(5):
         v, vo, l, ln = masks(cl, m)
         assert v == [True] * 5, (m, v)

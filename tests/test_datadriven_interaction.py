@@ -1,0 +1,83 @@
+"""Replay ALL reference interaction goldens (raft/testdata/*.txt) against
+the TPU engine through the InteractionEnv command language
+(raft/rafttest/interaction_env_handler.go:29-146, interaction_test.go:34).
+
+Comparison is semantic: structural output (Ready blocks, message lines,
+entries, status, raft-log) is compared verbatim; logger lines are reduced
+to a curated event vocabulary (role transitions, configuration switches,
+snapshot restores, newRaft boots) that both sides must produce in the
+same order, while incidental Go-logger prose (vote tallies, probe/pause
+DEBUG chatter) is dropped from both sides identically.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+from etcd_tpu.harness.datadriven import parse_file, reference_available, testdata
+from etcd_tpu.harness.interaction import InteractionEnv
+
+GOLDENS = [
+    "campaign.txt",
+    "campaign_learner_must_vote.txt",
+    "confchange_v1_add_single.txt",
+    "confchange_v1_remove_leader.txt",
+    "confchange_v2_add_double_auto.txt",
+    "confchange_v2_add_double_implicit.txt",
+    "confchange_v2_add_single_auto.txt",
+    "confchange_v2_add_single_explicit.txt",
+    "probe_and_replicate.txt",
+    "snapshot_succeed_via_app_resp.txt",
+]
+
+_LOG_TOKENS = ("INFO", "DEBUG", "WARN", "ERROR", "FATAL")
+
+# Curated logger events: both sides must agree on these exactly.
+_CURATED = [
+    ("become", re.compile(
+        r"(?:INFO|DEBUG) (\d+) became "
+        r"(follower|pre-candidate|candidate|leader) at term (\d+)$")),
+    ("switch", re.compile(
+        r"(?:INFO|DEBUG) (\d+) switched to configuration (.+)$")),
+    ("newraft", re.compile(r"(?:INFO|DEBUG) newRaft (\d+) \[(.+)\]$")),
+    ("restored", re.compile(
+        r"(?:INFO|DEBUG) (\d+) \[(.+)\] restored snapshot \[(.+)\]$")),
+]
+
+
+def normalize(text: str) -> list[tuple]:
+    events: list[tuple] = []
+    for raw in text.split("\n"):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.split(" ", 1)[0] in _LOG_TOKENS:
+            for kind, rx in _CURATED:
+                m = rx.match(line)
+                if m:
+                    events.append((kind,) + m.groups())
+                    break
+            continue
+        if line in ("ok", "ok (quiet)"):
+            # bare acknowledgements carry no semantic content: a golden
+            # block holding only non-curated logger prose normalizes to
+            # the same empty event list as our "ok"
+            continue
+        events.append(("line", re.sub(r"\s+", " ", line)))
+    return events
+
+
+@pytest.mark.skipif(not reference_available(), reason="no reference checkout")
+@pytest.mark.parametrize("fname", GOLDENS)
+def test_interaction_golden(fname):
+    env = InteractionEnv()
+    for case in parse_file(testdata("testdata", fname)):
+        out = env.handle(case)
+        exp = "\n".join(case.expected)
+        got, want = normalize(out), normalize(exp)
+        assert got == want, (
+            f"{fname}:{case.line} ({case.cmd} {case.args})\n"
+            f"-- expected --\n{exp}\n-- actual --\n{out}"
+        )

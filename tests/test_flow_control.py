@@ -1,0 +1,286 @@
+"""Flow-control, snapshot-path, and ring-exhaustion parity tests.
+
+Transliterations of raft/raft_flow_control_test.go (inflights pausing /
+freeing: TestMsgAppFlowControlFull / MoveForward / RecvHeartbeat) and
+raft/raft_snap_test.go (TestSendingSnapshotSetPendingSnapshot /
+TestPendingSnapshotPauseReplication / TestSnapshotFailure /
+TestSnapshotSucceed / TestSnapshotAbort), plus the ring-capacity case the
+reference cannot hit (its log is unbounded): a follower that lags past
+the leader's ring window recovers via MsgSnap.
+
+Driven through RawNode so messages inject exactly like the reference's
+r.Step(pb.Message{...}) whitebox calls.
+"""
+import pytest
+
+from etcd_tpu.models.rawnode import HostMsg, RawNode
+from etcd_tpu.storage.raftstorage import (
+    ConfState,
+    MemoryStorage,
+    Snapshot,
+    SnapshotMeta,
+)
+from etcd_tpu.types import (
+    MSG_APP,
+    MSG_APP_RESP,
+    MSG_HEARTBEAT_RESP,
+    MSG_SNAP,
+    MSG_SNAP_STATUS,
+    MSG_UNREACHABLE,
+    PR_PROBE,
+    PR_REPLICATE,
+    PR_SNAPSHOT,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import RaftConfig
+
+# small inflight window so pausing is reachable in a few proposals
+SPEC = Spec(M=3, L=16, E=2, K=4, W=2, R=2, A=4)
+CFG = RaftConfig(election_tick=3, heartbeat_tick=1, max_inflight=2)
+
+
+def new_leader():
+    """A 3-node group's leader lane with follower 1 in Replicate state
+    (the newTestRaft + becomeLeader + BecomeReplicate fixture)."""
+    s = MemoryStorage()
+    s.apply_snapshot(
+        Snapshot(
+            meta=SnapshotMeta(
+                index=2, term=1, conf_state=ConfState(voters=(0, 1, 2))
+            )
+        )
+    )
+    rn = RawNode(CFG, SPEC, s, 0, applied=2)
+    rn.campaign()
+    term = int(rn.n.term)
+    for p in (1, 2):
+        rn.step(HostMsg(type=4, to=0, frm=p, term=term))  # MsgVoteResp
+    assert int(rn.n.role) == ROLE_LEADER
+    rd = rn.ready()
+    s.append(rd.entries)
+    if rd.hard_state:
+        s.set_hard_state(rd.hard_state)
+    rn.advance(rd)
+    # follower 1 acks the empty entry -> Replicate
+    ack(rn, 1, int(rn.n.last_index))
+    drain(rn)
+    return rn, s
+
+
+def ack(rn, frm, index, reject=False, hint=0, hint_term=0):
+    rn.step(
+        HostMsg(
+            type=MSG_APP_RESP, to=0, frm=frm, term=int(rn.n.term),
+            index=index, reject=reject, reject_hint=hint, log_term=hint_term,
+        )
+    )
+
+
+def drain(rn):
+    """Harvest pending messages through a Ready/Advance cycle."""
+    rd = rn.ready()
+    rn.storage.append(rd.entries)
+    if rd.hard_state:
+        rn.storage.set_hard_state(rd.hard_state)
+    rn.advance(rd)
+    return rd.messages
+
+
+def apps_to(msgs, to):
+    return [m for m in msgs if m.type == MSG_APP and m.to == to]
+
+
+def pr(rn, i):
+    return rn.status().progress[i]
+
+
+# -- TestMsgAppFlowControlFull ----------------------------------------------
+def test_flow_control_full():
+    rn, _ = new_leader()
+    # fill follower 1's inflight window
+    for k in range(CFG.max_inflight):
+        assert rn.propose(100 + k)
+        assert len(apps_to(drain(rn), 1)) == 1
+    assert pr(rn, 1).inflight_full
+    # further proposals are accepted but not sent to the paused follower
+    for k in range(3):
+        assert rn.propose(200 + k)
+        assert apps_to(drain(rn), 1) == []
+
+
+# -- TestMsgAppFlowControlMoveForward ---------------------------------------
+def test_flow_control_move_forward():
+    rn, _ = new_leader()
+    first = int(rn.n.last_index) + 1
+    for k in range(CFG.max_inflight + 2):
+        rn.propose(300 + k)
+        drain(rn)
+    assert pr(rn, 1).inflight_full
+    # ack the first in-flight append: window slides, backlog resumes
+    ack(rn, 1, first)
+    sent = apps_to(drain(rn), 1)
+    assert len(sent) == 1 and sent[0].entries
+    assert pr(rn, 1).inflight_full  # refilled by the resumed send
+    # acking an index below match frees nothing and sends nothing
+    ack(rn, 1, first)
+    assert apps_to(drain(rn), 1) == []
+
+
+# -- TestMsgAppFlowControlRecvHeartbeat -------------------------------------
+def test_flow_control_heartbeat_resp_frees_one():
+    rn, _ = new_leader()
+    for k in range(CFG.max_inflight + 2):
+        rn.propose(400 + k)
+        drain(rn)
+    assert pr(rn, 1).inflight_full
+    for _ in range(2):
+        rn.step(
+            HostMsg(type=MSG_HEARTBEAT_RESP, to=0, frm=1, term=int(rn.n.term))
+        )
+        # one slot freed -> exactly one more append goes out
+        assert len(apps_to(drain(rn), 1)) == 1
+
+
+# -- raft_snap_test.go fixtures ---------------------------------------------
+def snapshot_leader():
+    """Leader whose ring has compacted past follower 2's position, with a
+    MsgSnap already sent (TestSendingSnapshotSetPendingSnapshot)."""
+    rn, s = new_leader()
+    # commit+apply a batch with follower 1 only; follower 2 stays at 0
+    for k in range(4):
+        rn.propose(500 + k)
+        drain(rn)
+        ack(rn, 1, int(rn.n.last_index))
+        drain(rn)
+    assert int(rn.n.applied) == int(rn.n.last_index)
+    rn.compact_to(int(rn.n.applied))
+    # follower 2 rejects the pending probe (prev = its next-1 = 2) with a
+    # hint of 0: the decremented next falls below the compaction point
+    probe_prev = pr(rn, 2).next - 1
+    ack(rn, 2, probe_prev, reject=True, hint=0, hint_term=0)
+    msgs = drain(rn)
+    snaps = [m for m in msgs if m.type == MSG_SNAP and m.to == 2]
+    assert len(snaps) == 1
+    return rn, s, snaps[0]
+
+
+def test_sending_snapshot_sets_pending():
+    rn, _, snap = snapshot_leader()
+    p = pr(rn, 2)
+    assert p.state == PR_SNAPSHOT
+    assert p.pending_snapshot == int(rn.n.applied)
+    assert snap.snapshot.meta.index == int(rn.n.applied)
+
+
+# -- TestPendingSnapshotPauseReplication ------------------------------------
+def test_pending_snapshot_pauses_replication():
+    rn, _, _ = snapshot_leader()
+    rn.propose(600)
+    assert apps_to(drain(rn), 2) == []
+
+
+# -- TestSnapshotFailure -----------------------------------------------------
+def test_snapshot_failure():
+    rn, _, _ = snapshot_leader()
+    rn.step(
+        HostMsg(type=MSG_SNAP_STATUS, to=0, frm=2, term=int(rn.n.term),
+                reject=True)
+    )
+    p = pr(rn, 2)
+    assert p.state == PR_PROBE
+    assert p.pending_snapshot == 0
+    assert p.next == 1  # match(0) + 1
+    assert p.paused  # probe_sent until the next heartbeat resp
+
+
+# -- TestSnapshotSucceed -----------------------------------------------------
+def test_snapshot_succeed():
+    rn, _, _ = snapshot_leader()
+    rn.step(
+        HostMsg(type=MSG_SNAP_STATUS, to=0, frm=2, term=int(rn.n.term),
+                reject=False)
+    )
+    p = pr(rn, 2)
+    assert p.state == PR_PROBE
+    assert p.pending_snapshot == 0
+    assert p.next == int(rn.n.applied) + 1
+    assert p.paused
+
+
+# -- TestSnapshotAbort (via AppResp >= pending) ------------------------------
+def test_snapshot_abort_on_app_resp():
+    rn, _, snap = snapshot_leader()
+    # the follower applied the snapshot out of band and acks at its index
+    ack(rn, 2, snap.snapshot.meta.index)
+    p = pr(rn, 2)
+    assert p.state == PR_REPLICATE
+    assert p.pending_snapshot == 0
+    assert p.match == snap.snapshot.meta.index
+
+
+# -- MsgUnreachable ----------------------------------------------------------
+def test_unreachable_drops_to_probe():
+    rn, _ = new_leader()
+    assert pr(rn, 1).state == PR_REPLICATE
+    rn.step(
+        HostMsg(type=MSG_UNREACHABLE, to=0, frm=1, term=int(rn.n.term))
+    )
+    p = pr(rn, 1)
+    assert p.state == PR_PROBE
+    assert p.next == p.match + 1
+
+
+# -- ring exhaustion + recovery via MsgSnap ---------------------------------
+def test_ring_exhaustion_recovers_via_snapshot():
+    """A follower that lags past the leader's ring window: the leader's
+    ring auto-compacts at the applied cursor (apply_round, the
+    triggerSnapshot analog), replication to the laggard falls back to
+    MsgSnap, and the restored follower catches up to matching state."""
+    rn, s = new_leader()
+    f2s = MemoryStorage()
+    f2s.apply_snapshot(
+        Snapshot(
+            meta=SnapshotMeta(
+                index=2, term=1, conf_state=ConfState(voters=(0, 1, 2))
+            )
+        )
+    )
+    f2 = RawNode(CFG, SPEC, f2s, 2, applied=2)
+
+    # push well past ring capacity (L=16) with only follower 1 acking
+    for k in range(SPEC.L + 8):
+        rn.propose(700 + k)
+        drain(rn)
+        ack(rn, 1, int(rn.n.last_index))
+        drain(rn)
+    assert int(rn.n.snap_index) > 2, "leader ring never compacted"
+
+    # heal: follower 2 reports in; the leader must fall back to MsgSnap
+    rn.step(HostMsg(type=MSG_HEARTBEAT_RESP, to=0, frm=2, term=int(rn.n.term)))
+    msgs = drain(rn)
+    snaps = [m for m in msgs if m.type == MSG_SNAP and m.to == 2]
+    assert len(snaps) == 1, f"expected MsgSnap, got {msgs}"
+
+    # deliver the snapshot, then run the ack/append loop to convergence
+    f2.step(snaps[0])
+    for _ in range(8):
+        rd = f2.ready()
+        f2s.set_hard_state(rd.hard_state) if rd.hard_state else None
+        f2s.append(rd.entries)
+        if rd.snapshot:
+            f2s.apply_snapshot(rd.snapshot)
+        f2.advance(rd)
+        for m in rd.messages:
+            if m.to == 0:
+                rn.step(m)
+        back = [m for m in drain(rn) if m.to == 2]
+        if not back:
+            break
+        for m in back:
+            f2.step(m)
+
+    assert int(f2.n.last_index) == int(rn.n.last_index)
+    assert int(f2.n.applied) == int(rn.n.applied)
+    assert int(f2.n.applied_hash) == int(rn.n.applied_hash)
+    assert pr(rn, 2).state == PR_REPLICATE

@@ -1,0 +1,174 @@
+"""MemoryStorage contract tests — transliterated from raft/storage_test.go
+(TestStorageTerm/Entries/LastIndex/FirstIndex/Compact/Append/
+ApplySnapshot/CreateSnapshot) with the reference's error taxonomy
+(raft/storage.go:24-38).
+
+Mapping note: the reference keeps a dummy entry at ents[0] marking the
+snapshot boundary ({Index:3, Term:3} in its shared fixture); our
+MemoryStorage stores that boundary in the snapshot metadata instead, so
+the fixture here is snap=(index 3, term 3) + real entries from 4 on.
+Member ids are 0-based.
+"""
+import pytest
+
+from etcd_tpu.storage.raftstorage import (
+    ConfState,
+    Entry,
+    ErrCompacted,
+    ErrSnapOutOfDate,
+    ErrUnavailable,
+    MemoryStorage,
+    Snapshot,
+    SnapshotMeta,
+)
+
+E4, E5, E6 = Entry(4, 4), Entry(5, 5), Entry(6, 6)
+
+
+def make(ents=(E4, E5)):
+    s = MemoryStorage()
+    s.apply_snapshot(Snapshot(meta=SnapshotMeta(index=3, term=3)))
+    s.ents = list(ents)
+    return s
+
+
+# -- TestStorageTerm ---------------------------------------------------------
+@pytest.mark.parametrize(
+    "i,want,err",
+    [
+        (2, 0, ErrCompacted),
+        (3, 3, None),  # snapshot boundary (the reference's dummy entry)
+        (4, 4, None),
+        (5, 5, None),
+        (6, 0, ErrUnavailable),
+    ],
+)
+def test_storage_term(i, want, err):
+    s = make()
+    if err:
+        with pytest.raises(err):
+            s.term(i)
+    else:
+        assert s.term(i) == want
+
+
+# -- TestStorageEntries ------------------------------------------------------
+@pytest.mark.parametrize(
+    "lo,hi,maxe,want,err",
+    [
+        (2, 6, None, None, ErrCompacted),
+        (3, 4, None, None, ErrCompacted),
+        (4, 5, None, [E4], None),
+        (4, 6, None, [E4, E5], None),
+        (4, 7, None, [E4, E5, E6], None),
+        (4, 8, None, None, ErrUnavailable),
+        (4, 7, 1, [E4], None),
+        (4, 7, 2, [E4, E5], None),
+    ],
+)
+def test_storage_entries(lo, hi, maxe, want, err):
+    s = make((E4, E5, E6))
+    if err:
+        with pytest.raises(err):
+            s.entries(lo, hi, maxe)
+    else:
+        assert s.entries(lo, hi, maxe) == want
+
+
+# -- TestStorageLastIndex / TestStorageFirstIndex ----------------------------
+def test_storage_first_last_index():
+    s = make()
+    assert s.first_index() == 4
+    assert s.last_index() == 5
+    s.append([Entry(6, 5)])
+    assert s.last_index() == 6
+    s.compact(4)
+    assert s.first_index() == 5
+    assert s.last_index() == 6
+
+
+# -- TestStorageCompact ------------------------------------------------------
+@pytest.mark.parametrize(
+    "i,windex,wterm,wlen,err",
+    [
+        (2, 3, 3, 3, ErrCompacted),
+        (3, 3, 3, 3, ErrCompacted),
+        (4, 4, 4, 2, None),
+        (5, 5, 5, 1, None),
+    ],
+)
+def test_storage_compact(i, windex, wterm, wlen, err):
+    s = make()
+    if err:
+        with pytest.raises(err):
+            s.compact(i)
+    else:
+        s.compact(i)
+        # windex/wterm describe the truncation boundary (the reference's
+        # dummy entry); wlen counts the dummy, so real entries are wlen-1
+        assert s.first_index() == windex + 1
+        assert s.term(windex) == wterm
+        assert len(s.ents) == wlen - 1
+        # the retained snapshot is untouched by compaction
+        assert s.snap.meta.index == 3
+
+
+# -- TestStorageAppend -------------------------------------------------------
+@pytest.mark.parametrize(
+    "ents,want",
+    [
+        # all compacted away: no-op
+        ([Entry(1, 1), Entry(2, 2)], [E4, E5]),
+        # overlap incl. the compacted boundary: prefix truncated away
+        ([Entry(3, 3), Entry(4, 4), Entry(5, 5)], [E4, E5]),
+        # conflict overwrite
+        ([Entry(3, 3), Entry(4, 6), Entry(5, 6)],
+         [Entry(4, 6), Entry(5, 6)]),
+        # extend past the end
+        ([Entry(3, 3), Entry(4, 4), Entry(5, 5), Entry(6, 5)],
+         [E4, E5, Entry(6, 5)]),
+        # overwrite mid-log truncates the tail
+        ([Entry(4, 5)], [Entry(4, 5)]),
+        ([Entry(5, 8)], [E4, Entry(5, 8)]),
+    ],
+)
+def test_storage_append(ents, want):
+    s = make()
+    s.append(ents)
+    assert s.ents == want
+
+
+def test_storage_append_gap_raises():
+    s = make()
+    with pytest.raises(ErrUnavailable):
+        s.append([Entry(8, 5)])
+
+
+# -- TestStorageApplySnapshot ------------------------------------------------
+def test_storage_apply_snapshot():
+    cs = ConfState(voters=(0, 1, 2))
+    s = MemoryStorage()
+    s.apply_snapshot(
+        Snapshot(meta=SnapshotMeta(index=4, term=4, conf_state=cs))
+    )
+    assert s.first_index() == 5 and s.last_index() == 4
+    # out-of-date snapshot is refused
+    with pytest.raises(ErrSnapOutOfDate):
+        s.apply_snapshot(
+            Snapshot(meta=SnapshotMeta(index=3, term=3, conf_state=cs))
+        )
+
+
+# -- TestStorageCreateSnapshot -----------------------------------------------
+def test_storage_create_snapshot():
+    cs = ConfState(voters=(0, 1, 2))
+    s = make()
+    snap = s.create_snapshot(4, cs, data=(7,))
+    assert snap.meta.index == 4 and snap.meta.term == 4
+    assert snap.meta.conf_state == cs and snap.data == (7,)
+    # entries retained until an explicit compact (matching the reference)
+    assert s.last_index() == 5 and len(s.ents) == 2
+    with pytest.raises(ErrSnapOutOfDate):
+        s.create_snapshot(3, cs)
+    with pytest.raises(ErrUnavailable):
+        s.create_snapshot(9, cs)

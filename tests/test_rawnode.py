@@ -1,0 +1,249 @@
+"""RawNode Ready/Advance contract tests — transliterations of the key
+cases in raft/rawnode_test.go (Step guards, propose + conf change,
+Start/Restart Ready sequences, read index, snapshot restart), driven
+against the device-lane kernels.
+"""
+import pytest
+
+from etcd_tpu.models.rawnode import (
+    DeviceLaneStorage,
+    ErrStepLocalMsg,
+    ErrStepPeerNotFound,
+    HostMsg,
+    RawNode,
+)
+from etcd_tpu.models import confchange as ccmod
+from etcd_tpu.storage.raftstorage import (
+    ConfState,
+    Entry,
+    HardState,
+    MemoryStorage,
+    Snapshot,
+    SnapshotMeta,
+)
+from etcd_tpu.types import (
+    CC_ADD_NODE,
+    ENTRY_CONF_CHANGE,
+    MSG_APP_RESP,
+    MSG_HEARTBEAT,
+    MSG_HUP,
+    MSG_PROP,
+    MSG_READ_INDEX_RESP,
+    NONE_ID,
+    ROLE_FOLLOWER,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import RaftConfig
+
+# one (cfg, spec) for the whole module so the lane kernels compile once
+SPEC = Spec(M=8, L=64, E=16, K=8, W=8, R=4, A=8)
+CFG = RaftConfig(election_tick=3, heartbeat_tick=1, max_inflight=8)
+
+
+def boot(nid=0, voters=(0, 1, 2), index=2):
+    s = MemoryStorage()
+    s.apply_snapshot(
+        Snapshot(
+            meta=SnapshotMeta(
+                index=index, term=1, conf_state=ConfState(voters=voters)
+            )
+        )
+    )
+    return RawNode(CFG, SPEC, s, nid, applied=index), s
+
+
+def drive_to_leader(rn, s, peers=(1, 2)):
+    """Campaign and fake the quorum of vote responses."""
+    rn.campaign()
+    rd = rn.ready()
+    s.set_hard_state(rd.hard_state)
+    rn.advance(rd)
+    term = int(rn.n.term)
+    for p in peers:
+        rn.step(HostMsg(type=4, to=rn.nid, frm=p, term=term))  # MsgVoteResp
+        if int(rn.n.role) == ROLE_LEADER:
+            break
+    assert int(rn.n.role) == ROLE_LEADER
+
+
+# -- TestRawNodeStep ---------------------------------------------------------
+def test_step_refuses_local_messages():
+    rn, _ = boot()
+    with pytest.raises(ErrStepLocalMsg):
+        rn.step(HostMsg(type=MSG_HUP, to=0, frm=0))
+    with pytest.raises(ErrStepLocalMsg):
+        rn.step(HostMsg(type=MSG_PROP, to=0, frm=0))
+
+
+def test_step_refuses_response_from_unknown_peer():
+    rn, _ = boot(voters=(0, 1))
+    # member 5 is not in the config: response messages bounce
+    with pytest.raises(ErrStepPeerNotFound):
+        rn.step(HostMsg(type=MSG_APP_RESP, to=0, frm=5, term=1))
+    # non-response messages from unknown peers are fine (pre-config MsgApp)
+    rn.step(HostMsg(type=MSG_HEARTBEAT, to=0, frm=5, term=1))
+
+
+# -- TestRawNodeProposeAndConfChange (core variant) --------------------------
+def test_propose_and_conf_change():
+    rn, s = boot()
+    drive_to_leader(rn, s)
+    rd = rn.ready()  # leader's empty entry
+    s.set_hard_state(rd.hard_state) if rd.hard_state else None
+    s.append(rd.entries)
+    rn.advance(rd)
+
+    assert rn.propose(41)
+    word = ccmod.encode([(CC_ADD_NODE, 3)])
+    assert rn.propose_conf_change(word)
+    # commit via acks from the quorum
+    last = int(rn.n.last_index)
+    term = int(rn.n.term)
+    for p in (1, 2):
+        rn.step(HostMsg(type=MSG_APP_RESP, to=0, frm=p, term=term, index=last))
+    rd = rn.ready()
+    s.set_hard_state(rd.hard_state) if rd.hard_state else None
+    s.append(rd.entries)
+    types = [e.type for e in rd.committed_entries]
+    assert ENTRY_CONF_CHANGE in types
+    rn.advance(rd)
+    # the conf change took effect and was reported
+    assert rn.last_conf_states, "conf switch not reported by Advance"
+    assert 3 in rn.conf_state().voters
+    # pending_conf_index guard cleared: a second conf change is accepted
+    assert rn.propose_conf_change(ccmod.encode([(CC_ADD_NODE, 4)]))
+
+
+# -- TestRawNodeStart --------------------------------------------------------
+def test_ready_sequence_from_boot():
+    rn, s = boot()
+    assert not rn.has_ready()
+    rn.campaign()
+    assert rn.has_ready()
+    rd = rn.ready()
+    # campaign: hard state (term+vote) changed, must sync
+    assert rd.must_sync and rd.hard_state.term == 1
+    assert rd.soft_state is not None
+    assert int(rd.hard_state.vote) == 0
+    s.set_hard_state(rd.hard_state)
+    rn.advance(rd)
+    assert not rn.has_ready()
+
+
+def test_commit_only_ready_is_not_sync():
+    rn, s = boot()
+    drive_to_leader(rn, s)
+    rd = rn.ready()
+    s.append(rd.entries)
+    if rd.hard_state:
+        s.set_hard_state(rd.hard_state)
+    rn.advance(rd)
+    # acks commit the empty entry: the next Ready carries only a commit
+    # bump (and the committed entry), which MustSync=false
+    last, term = int(rn.n.last_index), int(rn.n.term)
+    for p in (1, 2):
+        rn.step(HostMsg(type=MSG_APP_RESP, to=0, frm=p, term=term, index=last))
+    rd = rn.ready()
+    assert rd.hard_state is not None and rd.hard_state.commit == last
+    assert not rd.must_sync
+    assert [e.index for e in rd.committed_entries] == [last]
+    rn.advance(rd)
+
+
+# -- TestRawNodeRestart ------------------------------------------------------
+def test_restart_from_storage():
+    s = MemoryStorage()
+    s.apply_snapshot(
+        Snapshot(
+            meta=SnapshotMeta(
+                index=2, term=1, conf_state=ConfState(voters=(0, 1, 2))
+            )
+        )
+    )
+    s.append([Entry(index=3, term=1, data=7)])
+    s.set_hard_state(HardState(term=1, vote=NONE_ID, commit=3))
+    rn = RawNode(CFG, SPEC, s, 0, applied=2)
+    # restart surfaces the committed-but-unapplied entry, nothing else
+    rd = rn.ready()
+    assert rd.hard_state is None  # unchanged vs storage
+    assert rd.entries == []
+    assert [e.index for e in rd.committed_entries] == [3]
+    assert not rd.must_sync
+    rn.advance(rd)
+    assert not rn.has_ready()
+    assert int(rn.n.applied) == 3
+
+
+# -- TestRawNodeRestartFromSnapshot -----------------------------------------
+def test_restart_from_snapshot():
+    s = MemoryStorage()
+    s.apply_snapshot(
+        Snapshot(
+            meta=SnapshotMeta(
+                index=5, term=2, conf_state=ConfState(voters=(0, 1)),
+                app_hash=99,
+            )
+        )
+    )
+    s.set_hard_state(HardState(term=2, vote=NONE_ID, commit=5))
+    rn = RawNode(CFG, SPEC, s, 0, applied=5)
+    assert not rn.has_ready()
+    assert int(rn.n.commit) == 5
+    assert int(rn.n.applied_hash) == 99
+    assert rn.conf_state().voters == (0, 1)
+
+
+# -- TestRawNodeReadIndex ----------------------------------------------------
+def test_read_index_leader():
+    rn, s = boot()
+    drive_to_leader(rn, s)
+    rd = rn.ready()
+    s.append(rd.entries)
+    if rd.hard_state:
+        s.set_hard_state(rd.hard_state)
+    rn.advance(rd)
+    last, term = int(rn.n.last_index), int(rn.n.term)
+    for p in (1, 2):
+        rn.step(HostMsg(type=MSG_APP_RESP, to=0, frm=p, term=term, index=last))
+    rd = rn.ready()
+    rn.advance(rd)  # commit in current term established
+
+    rn.read_index(ctx=7)
+    rd = rn.ready()
+    # ReadOnlySafe: a heartbeat round with the ctx goes out
+    hb = [m for m in rd.messages if m.type == MSG_HEARTBEAT]
+    assert len(hb) == 2 and all(m.context == 7 for m in hb)
+    rn.advance(rd)
+    for p in (1, 2):
+        rn.step(
+            HostMsg(type=7, to=0, frm=p, term=term, context=7)
+        )  # MsgHeartbeatResp
+    rd = rn.ready()
+    assert [ (r.request_ctx, r.index) for r in rd.read_states ] == [(7, last)]
+    rn.advance(rd)
+
+
+# -- DeviceLaneStorage -------------------------------------------------------
+def test_device_lane_storage_contract():
+    from etcd_tpu.storage.raftstorage import ErrCompacted, ErrUnavailable
+
+    rn, s = boot()
+    drive_to_leader(rn, s)
+    rd = rn.ready()
+    s.append(rd.entries)
+    rn.advance(rd)
+    lane = DeviceLaneStorage(rn)
+    assert lane.first_index() == 3
+    assert lane.last_index() == int(rn.n.last_index)
+    assert lane.term(2) == 1  # snapshot boundary
+    with pytest.raises(ErrCompacted):
+        lane.entries(1, 3)
+    with pytest.raises(ErrUnavailable):
+        lane.entries(3, lane.last_index() + 2)
+    ents = lane.entries(3, lane.last_index() + 1)
+    assert [e.index for e in ents] == [3]
+    hs, cs = lane.initial_state()
+    assert hs.term == int(rn.n.term) and cs.voters == (0, 1, 2)
+    snap = lane.snapshot()
+    assert snap.meta.index == 2 and snap.meta.term == 1
