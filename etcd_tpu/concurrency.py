@@ -73,7 +73,11 @@ class Mutex:
         for _ in range(max_rounds):
             if self.try_lock():
                 return
-            self.s.client.ec.tick()
+            # step, don't tick: the wait loop only needs raft rounds to
+            # flush; advancing the raft timers here would fast-forward
+            # lease TTLs (wall-clock seconds) by hundreds of seconds in
+            # milliseconds and expire other sessions' locks
+            self.s.client.ec.step()
         raise ConcurrencyError("lock: timed out")
 
     def unlock(self) -> None:
@@ -118,7 +122,7 @@ class Election:
         for _ in range(max_rounds):
             if self.is_leader():
                 return
-            c.ec.tick()
+            c.ec.step()  # see Mutex.lock: no lease-clock fast-forward
         raise ConcurrencyError("campaign: timed out")
 
     def proclaim(self, value: bytes) -> None:

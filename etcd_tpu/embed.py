@@ -1,0 +1,131 @@
+"""Process assembly: embed.Config + start_etcd.
+
+The reference's ``embed`` package is the library form of the server
+process: one Config struct carrying every flag (server/embed/config.go),
+``StartEtcd(cfg)`` wiring listeners + EtcdServer + v3rpc together
+(server/embed/etcd.go:104), and etcdmain as the CLI shell around it.
+
+Here ``start_etcd(Config)`` boots the batched fleet (one simulated
+multi-member cluster), serves the v3 JSON/HTTP API on the client URL,
+and runs the tick loop (heartbeats, lease expiry, auto-compaction) on a
+background thread — the process-level analog of raftNode's ticker +
+the compactor + lessor runLoop goroutines.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from etcd_tpu.server.compactor import Compactor
+from etcd_tpu.server.kvserver import EtcdCluster
+from etcd_tpu.server.v3rpc import V3Server
+
+
+@dataclasses.dataclass
+class Config:
+    """The embed.Config analog (server/embed/config.go), trimmed to the
+    knobs the TPU runtime honors."""
+
+    name: str = "default"
+    data_dir: str | None = None
+    listen_client_host: str = "127.0.0.1"
+    listen_client_port: int = 0  # 0 = ephemeral
+    cluster_size: int = 3
+    tick_ms: int = 100                  # --heartbeat-interval
+    election_ticks: int = 10            # --election-timeout / tick
+    quota_backend_bytes: int = 0        # --quota-backend-bytes
+    auto_compaction_mode: str = "off"   # --auto-compaction-mode
+    auto_compaction_retention: int = 0  # --auto-compaction-retention
+    pre_vote: bool = True               # --pre-vote
+    check_quorum: bool = True
+    auto_tick: bool = True              # background ticker on/off
+
+    def validate(self) -> None:
+        if self.cluster_size < 1:
+            raise ValueError("cluster size must be >= 1")
+        if self.tick_ms <= 0:
+            raise ValueError("tick interval must be positive")
+        if self.auto_compaction_mode not in ("off", "periodic", "revision"):
+            raise ValueError(
+                f"unknown auto-compaction mode {self.auto_compaction_mode}"
+            )
+
+
+class Etcd:
+    """A running embedded server (embed.Etcd analog)."""
+
+    def __init__(self, cfg: Config):
+        cfg.validate()
+        self.config = cfg
+        from etcd_tpu.harness.cluster import Cluster
+        from etcd_tpu.utils.config import RaftConfig
+
+        raft_cfg = RaftConfig(
+            election_tick=max(cfg.election_ticks, 2),
+            heartbeat_tick=1,
+            pre_vote=cfg.pre_vote,
+            check_quorum=cfg.check_quorum,
+        )
+        self.server = EtcdCluster(
+            n_members=cfg.cluster_size,
+            cluster=Cluster(n_members=cfg.cluster_size, cfg=raft_cfg),
+            quota_bytes=cfg.quota_backend_bytes,
+            data_dir=cfg.data_dir,
+        )
+        self.server.ensure_leader()
+        self.compactor = Compactor(
+            self.server, cfg.auto_compaction_mode,
+            cfg.auto_compaction_retention,
+        )
+        self.http = V3Server(
+            self.server, cfg.listen_client_host, cfg.listen_client_port
+        ).start()
+        self._stop = threading.Event()
+        self._ticker: threading.Thread | None = None
+        if cfg.auto_tick:
+            self._ticker = threading.Thread(target=self._tick_loop,
+                                            daemon=True)
+            self._ticker.start()
+
+    @property
+    def client_url(self) -> str:
+        return f"http://{self.config.listen_client_host}:{self.http.port}"
+
+    def _tick_loop(self) -> None:
+        period = self.config.tick_ms / 1000.0
+        # lease TTLs are seconds (lease/lessor.go): accumulate wall time
+        # and advance the lease clock once per elapsed second, whatever
+        # the raft tick rate (sub-second or multi-second) is
+        owed = 0.0
+        while not self._stop.wait(period):
+            owed += period
+            advance = int(owed)
+            owed -= advance
+            with self.http.api.lock:
+                self.server.tick(lease_clock=advance >= 1)
+                for _ in range(advance - 1):  # tick_ms > 1000: catch up
+                    self.server.advance_lease_clock()
+                self.compactor.tick()
+
+    def tick(self, n: int = 1) -> None:
+        """Manual clock (auto_tick=False mode, for tests): each call is
+        one raft tick AND one lease-clock second."""
+        with self.http.api.lock:
+            for _ in range(n):
+                self.server.tick()
+                self.compactor.tick()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._ticker:
+            self._ticker.join(timeout=2)
+        self.http.stop()
+        for ms in self.server.members:
+            if ms.backend is not None:
+                ms.backend.close()
+
+
+def start_etcd(cfg: Config) -> Etcd:
+    """embed.StartEtcd (server/embed/etcd.go:104)."""
+    return Etcd(cfg)

@@ -1,0 +1,314 @@
+"""etcdctl analog: a user CLI speaking the v3 JSON/HTTP API.
+
+Mirrors the reference's etcdctl command surface (etcdctl/ctlv3/command)
+over the gateway endpoints served by etcd_tpu.server.v3rpc: get / put /
+del / txn / watch / lease / member / endpoint status / alarm / compaction
+/ snapshot save / elect / lock / auth / user / role.
+
+Usage:
+    python -m etcd_tpu.etcdctl --endpoint http://127.0.0.1:2379 put k v
+    python -m etcd_tpu.etcdctl get k --prefix
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import urllib.request
+
+
+class Ctl:
+    def __init__(self, endpoint: str, token: str | None = None):
+        self.endpoint = endpoint.rstrip("/")
+        self.token = token
+
+    def call(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.endpoint + path,
+            data=json.dumps(body).encode(),
+            headers={
+                "Content-Type": "application/json",
+                **({"Authorization": self.token} if self.token else {}),
+            },
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            out = json.loads(e.read() or b"{}")
+            raise SystemExit(f"Error: {out.get('error', e)}")
+
+    def get_http(self, path: str) -> bytes:
+        with urllib.request.urlopen(self.endpoint + path) as resp:
+            return resp.read()
+
+
+def b64(s: str | bytes) -> str:
+    if isinstance(s, str):
+        s = s.encode()
+    return base64.b64encode(s).decode()
+
+
+def unb64(s: str | None) -> str:
+    return base64.b64decode(s).decode(errors="replace") if s else ""
+
+
+def _print_kvs(res: dict, write=print) -> None:
+    for kv in res.get("kvs", []):
+        write(unb64(kv["key"]))
+        write(unb64(kv.get("value")))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="etcdctl-tpu")
+    p.add_argument("--endpoint", default="http://127.0.0.1:2379")
+    p.add_argument("--user", default=None, help="name:password")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("key")
+    g.add_argument("range_end", nargs="?")
+    g.add_argument("--prefix", action="store_true")
+    g.add_argument("--rev", type=int, default=0)
+    g.add_argument("--limit", type=int, default=0)
+    g.add_argument("--count-only", action="store_true")
+
+    pu = sub.add_parser("put")
+    pu.add_argument("key")
+    pu.add_argument("value")
+    pu.add_argument("--lease", type=int, default=0)
+
+    d = sub.add_parser("del")
+    d.add_argument("key")
+    d.add_argument("range_end", nargs="?")
+    d.add_argument("--prefix", action="store_true")
+
+    t = sub.add_parser("txn", help="JSON txn body on stdin")
+
+    w = sub.add_parser("watch")
+    w.add_argument("key")
+    w.add_argument("--prefix", action="store_true")
+    w.add_argument("--rev", type=int, default=0)
+    w.add_argument("--polls", type=int, default=1)
+
+    lease = sub.add_parser("lease")
+    lsub = lease.add_subparsers(dest="lease_cmd", required=True)
+    lg = lsub.add_parser("grant"); lg.add_argument("id", type=int); lg.add_argument("ttl", type=int)
+    lr = lsub.add_parser("revoke"); lr.add_argument("id", type=int)
+    lk = lsub.add_parser("keep-alive"); lk.add_argument("id", type=int)
+    lt = lsub.add_parser("timetolive"); lt.add_argument("id", type=int)
+    lsub.add_parser("list")
+
+    mem = sub.add_parser("member")
+    msub = mem.add_subparsers(dest="member_cmd", required=True)
+    ma = msub.add_parser("add"); ma.add_argument("id", type=int); ma.add_argument("--learner", action="store_true")
+    mr = msub.add_parser("remove"); mr.add_argument("id", type=int)
+    mp = msub.add_parser("promote"); mp.add_argument("id", type=int)
+    msub.add_parser("list")
+
+    ep = sub.add_parser("endpoint")
+    esub = ep.add_subparsers(dest="ep_cmd", required=True)
+    esub.add_parser("status")
+    esub.add_parser("health")
+    esub.add_parser("hashkv")
+
+    al = sub.add_parser("alarm")
+    al.add_argument("alarm_cmd", choices=("list", "disarm"))
+
+    cp = sub.add_parser("compaction")
+    cp.add_argument("rev", type=int)
+
+    sn = sub.add_parser("snapshot")
+    ssub = sn.add_subparsers(dest="snap_cmd", required=True)
+    sv = ssub.add_parser("save"); sv.add_argument("path")
+
+    el = sub.add_parser("elect")
+    el.add_argument("name")
+    el.add_argument("value", nargs="?")
+    el.add_argument("--lease", type=int, default=0)
+    el.add_argument("--listen", action="store_true", help="print the leader")
+
+    lk2 = sub.add_parser("lock")
+    lk2.add_argument("name")
+    lk2.add_argument("--lease", type=int, default=0)
+
+    au = sub.add_parser("auth")
+    au.add_argument("auth_cmd", choices=("enable", "disable"))
+
+    us = sub.add_parser("user")
+    usub = us.add_subparsers(dest="user_cmd", required=True)
+    ua = usub.add_parser("add"); ua.add_argument("name"); ua.add_argument("password")
+    ud = usub.add_parser("delete"); ud.add_argument("name")
+    ug = usub.add_parser("grant-role"); ug.add_argument("name"); ug.add_argument("role")
+
+    ro = sub.add_parser("role")
+    rsub = ro.add_subparsers(dest="role_cmd", required=True)
+    ra = rsub.add_parser("add"); ra.add_argument("name")
+    rg = rsub.add_parser("grant-permission")
+    rg.add_argument("name"); rg.add_argument("perm_type",
+                                             choices=("read", "write", "readwrite"))
+    rg.add_argument("key"); rg.add_argument("range_end", nargs="?")
+
+    args = p.parse_args(argv)
+    ctl = Ctl(args.endpoint)
+    if args.user:
+        name, _, pw = args.user.partition(":")
+        ctl.token = ctl.call("/v3/auth/authenticate",
+                             {"name": name, "password": pw})["token"]
+
+    def range_end_of(key: str, range_end, prefix: bool):
+        if range_end:
+            return b64(range_end)
+        if prefix:
+            k = key.encode()
+            end = bytearray(k)
+            for i in reversed(range(len(end))):
+                if end[i] < 0xFF:
+                    end[i] += 1
+                    return b64(bytes(end[: i + 1]))
+            return b64(b"\x00")
+        return None
+
+    c = args.cmd
+    if c == "get":
+        body = {"key": b64(args.key), "revision": args.rev,
+                "limit": args.limit, "count_only": args.count_only}
+        re_ = range_end_of(args.key, args.range_end, args.prefix)
+        if re_:
+            body["range_end"] = re_
+        res = ctl.call("/v3/kv/range", body)
+        if args.count_only:
+            print(res.get("count", "0"))
+        else:
+            _print_kvs(res)
+    elif c == "put":
+        ctl.call("/v3/kv/put", {"key": b64(args.key), "value": b64(args.value),
+                                "lease": args.lease})
+        print("OK")
+    elif c == "del":
+        body = {"key": b64(args.key)}
+        re_ = range_end_of(args.key, args.range_end, args.prefix)
+        if re_:
+            body["range_end"] = re_
+        res = ctl.call("/v3/kv/deleterange", body)
+        print(res.get("deleted", "0"))
+    elif c == "txn":
+        print(json.dumps(ctl.call("/v3/kv/txn", json.load(sys.stdin))))
+    elif c == "watch":
+        body = {"create_request": {"key": b64(args.key),
+                                   "start_revision": args.rev}}
+        re_ = range_end_of(args.key, None, args.prefix)
+        if re_:
+            body["create_request"]["range_end"] = re_
+        wid = ctl.call("/v3/watch", body)["watch_id"]
+        for _ in range(args.polls):
+            res = ctl.call("/v3/watch", {"poll_request": {"watch_id": wid}})
+            for ev in res.get("events", []):
+                print(ev["type"])
+                print(unb64(ev["kv"]["key"]))
+                print(unb64(ev["kv"].get("value")))
+        ctl.call("/v3/watch", {"cancel_request": {"watch_id": wid}})
+    elif c == "lease":
+        lc = args.lease_cmd
+        if lc == "grant":
+            res = ctl.call("/v3/lease/grant", {"ID": args.id, "TTL": args.ttl})
+            print(f"lease {res['ID']} granted with TTL({res['TTL']}s)")
+        elif lc == "revoke":
+            ctl.call("/v3/lease/revoke", {"ID": args.id})
+            print(f"lease {args.id} revoked")
+        elif lc == "keep-alive":
+            res = ctl.call("/v3/lease/keepalive", {"ID": args.id})
+            print(f"lease {res['ID']} keepalived with TTL({res['TTL']})")
+        elif lc == "timetolive":
+            res = ctl.call("/v3/lease/timetolive", {"ID": args.id})
+            print(f"lease {res['ID']} remaining ttl {res['TTL']}")
+        else:
+            for l in ctl.call("/v3/lease/leases", {}).get("leases", []):
+                print(l["ID"])
+    elif c == "member":
+        mc = args.member_cmd
+        if mc == "add":
+            ctl.call("/v3/cluster/member/add",
+                     {"ID": args.id, "is_learner": args.learner})
+            print(f"Member {args.id} added")
+        elif mc == "remove":
+            ctl.call("/v3/cluster/member/remove", {"ID": args.id})
+            print(f"Member {args.id} removed")
+        elif mc == "promote":
+            ctl.call("/v3/cluster/member/promote", {"ID": args.id})
+            print(f"Member {args.id} promoted")
+        else:
+            for m in ctl.call("/v3/cluster/member/list", {}).get("members", []):
+                kind = "learner" if m.get("is_learner") else "voter"
+                print(f"{m['ID']}: {kind}")
+    elif c == "endpoint":
+        if args.ep_cmd == "status":
+            print(json.dumps(ctl.call("/v3/maintenance/status", {})))
+        elif args.ep_cmd == "health":
+            print(ctl.get_http("/health").decode().strip())
+        else:
+            print(ctl.call("/v3/maintenance/hash", {})["hash"])
+    elif c == "alarm":
+        if args.alarm_cmd == "list":
+            res = ctl.call("/v3/maintenance/alarm", {"action": "GET"})
+        else:
+            res = ctl.call("/v3/maintenance/alarm", {"action": "DEACTIVATE"})
+        for a in res.get("alarms", []):
+            print(a["alarm"])
+    elif c == "compaction":
+        ctl.call("/v3/kv/compaction", {"revision": args.rev})
+        print(f"compacted revision {args.rev}")
+    elif c == "snapshot":
+        blob = ctl.call("/v3/maintenance/snapshot", {})["blob"]
+        with open(args.path, "wb") as f:
+            f.write(base64.b64decode(blob))
+        print(f"Snapshot saved at {args.path}")
+    elif c == "elect":
+        if args.listen or args.value is None:
+            res = ctl.call("/v3/election/leader", {"name": b64(args.name)})
+            print(unb64(res["kv"]["value"]))
+        else:
+            res = ctl.call(
+                "/v3/election/campaign",
+                {"name": b64(args.name), "value": b64(args.value),
+                 "lease": args.lease},
+            )
+            print(unb64(res["leader"]["key"]))
+    elif c == "lock":
+        res = ctl.call("/v3/lock/lock",
+                       {"name": b64(args.name), "lease": args.lease})
+        print(unb64(res["key"]))
+    elif c == "auth":
+        ctl.call(f"/v3/auth/{args.auth_cmd}", {})
+        print(f"Authentication {'Enabled' if args.auth_cmd == 'enable' else 'Disabled'}")
+    elif c == "user":
+        uc = args.user_cmd
+        if uc == "add":
+            ctl.call("/v3/auth/user/add",
+                     {"name": args.name, "password": args.password})
+            print(f"User {args.name} created")
+        elif uc == "delete":
+            ctl.call("/v3/auth/user/delete", {"name": args.name})
+            print(f"User {args.name} deleted")
+        else:
+            ctl.call("/v3/auth/user/grant",
+                     {"name": args.name, "role": args.role})
+            print(f"Role {args.role} is granted to user {args.name}")
+    elif c == "role":
+        rc = args.role_cmd
+        if rc == "add":
+            ctl.call("/v3/auth/role/add", {"name": args.name})
+            print(f"Role {args.name} created")
+        else:
+            perm = {"permType": args.perm_type.upper(), "key": b64(args.key)}
+            if args.range_end:
+                perm["range_end"] = b64(args.range_end)
+            ctl.call("/v3/auth/role/grant", {"name": args.name, "perm": perm})
+            print(f"Role {args.name} updated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

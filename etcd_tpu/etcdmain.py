@@ -1,0 +1,87 @@
+"""CLI shell: the etcdmain analog (server/etcdmain/main.go:25,
+etcd.go:52) — parse flags into an embed.Config, start the server, serve
+until interrupted.
+
+Usage:
+    python -m etcd_tpu.etcdmain --listen-client-port 2379 \
+        --data-dir /tmp/etcd-tpu --cluster-size 3
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="etcd-tpu",
+        description="TPU-native batched etcd: serve the v3 JSON/HTTP API "
+        "over one simulated multi-member cluster",
+    )
+    p.add_argument("--name", default="default")
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--listen-client-host", default="127.0.0.1")
+    p.add_argument("--listen-client-port", type=int, default=2379)
+    p.add_argument("--cluster-size", type=int, default=3)
+    p.add_argument("--heartbeat-interval", type=int, default=100,
+                   metavar="MS", dest="tick_ms")
+    p.add_argument("--election-timeout", type=int, default=1000,
+                   metavar="MS")
+    p.add_argument("--quota-backend-bytes", type=int, default=0)
+    p.add_argument("--auto-compaction-mode", default="off",
+                   choices=("off", "periodic", "revision"))
+    p.add_argument("--auto-compaction-retention", type=int, default=0)
+    p.add_argument("--pre-vote", action="store_true", default=True)
+    return p
+
+
+def main(argv=None) -> int:
+    # honor an explicit JAX_PLATFORMS request (this environment's
+    # sitecustomize re-pins the accelerator platform at interpreter
+    # start, so the env var alone is not enough) and reuse the repo's
+    # persistent compile cache for fast process starts
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    cache = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+    if os.path.isdir(cache):
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+    from etcd_tpu.embed import Config, start_etcd
+
+    args = build_parser().parse_args(argv)
+    cfg = Config(
+        name=args.name,
+        data_dir=args.data_dir,
+        listen_client_host=args.listen_client_host,
+        listen_client_port=args.listen_client_port,
+        cluster_size=args.cluster_size,
+        tick_ms=args.tick_ms,
+        election_ticks=max(args.election_timeout // max(args.tick_ms, 1), 2),
+        quota_backend_bytes=args.quota_backend_bytes,
+        auto_compaction_mode=args.auto_compaction_mode,
+        auto_compaction_retention=args.auto_compaction_retention,
+        pre_vote=args.pre_vote,
+    )
+    etcd = start_etcd(cfg)
+    print(f"etcd-tpu '{cfg.name}' serving {etcd.client_url} "
+          f"({cfg.cluster_size} members)", file=sys.stderr)
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    finally:
+        etcd.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""etcdutl analog: offline admin over a data directory.
+
+The reference's etcdutl operates directly on files with no server
+running (etcdutl/etcdutl: snapshot status/restore, defrag, hashkv).
+Commands here work on the backend files etcd_tpu writes
+(<data-dir>/member<N>.db) and the snapshot blobs etcdctl saves.
+
+Usage:
+    python -m etcd_tpu.etcdutl snapshot status snap.json
+    python -m etcd_tpu.etcdutl hashkv --data-dir D --member 0
+    python -m etcd_tpu.etcdutl defrag --data-dir D
+    python -m etcd_tpu.etcdutl status --data-dir D
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _member_paths(data_dir: str) -> list[str]:
+    return sorted(glob.glob(os.path.join(data_dir, "member*.db")))
+
+
+def _load(path: str):
+    from etcd_tpu.storage import schema
+    from etcd_tpu.storage.backend import Backend
+
+    be = Backend(path)
+    meta = schema.load_applied_meta(be) or {}
+    store = schema.load_mvcc(
+        be,
+        max_rev=meta.get("current_rev"),
+        compact_rev=meta.get("compact_rev", 0),
+    )
+    return be, meta, store
+
+
+def cmd_snapshot_status(args) -> int:
+    with open(args.path, "rb") as f:
+        snap = json.load(f)
+    kv = snap.get("kv", {})
+    print(json.dumps({
+        "applied_index": snap.get("applied_index"),
+        "revision": kv.get("current_rev"),
+        "compact_revision": kv.get("compact_rev"),
+        "total_key_revisions": len(kv.get("revs", [])),
+        "alarms": snap.get("alarms", []),
+    }))
+    return 0
+
+
+def cmd_hashkv(args) -> int:
+    path = os.path.join(args.data_dir, f"member{args.member}.db")
+    _, meta, store = _load(path)
+    print(json.dumps({
+        "member": args.member,
+        "hash": store.hash_kv(),
+        "revision": store.current_rev,
+        "consistent_index": meta.get("consistent_index", 0),
+    }))
+    return 0
+
+
+def cmd_defrag(args) -> int:
+    for path in _member_paths(args.data_dir):
+        from etcd_tpu.storage.backend import Backend
+
+        be = Backend(path)
+        before = be.size()
+        be.defrag()
+        be.close()
+        print(f"{os.path.basename(path)}: {before} -> {be.size()} bytes")
+    return 0
+
+
+def cmd_status(args) -> int:
+    out = []
+    for path in _member_paths(args.data_dir):
+        be, meta, store = _load(path)
+        out.append({
+            "member": os.path.basename(path),
+            "size": be.size(),
+            "size_in_use": be.size_in_use(),
+            "consistent_index": meta.get("consistent_index", 0),
+            "term": meta.get("term", 0),
+            "revision": store.current_rev,
+            "compact_revision": store.compact_rev,
+            "keys": len(store.index),
+        })
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="etcdutl-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sn = sub.add_parser("snapshot")
+    ssub = sn.add_subparsers(dest="snap_cmd", required=True)
+    st = ssub.add_parser("status")
+    st.add_argument("path")
+
+    h = sub.add_parser("hashkv")
+    h.add_argument("--data-dir", required=True)
+    h.add_argument("--member", type=int, default=0)
+
+    d = sub.add_parser("defrag")
+    d.add_argument("--data-dir", required=True)
+
+    s = sub.add_parser("status")
+    s.add_argument("--data-dir", required=True)
+
+    args = p.parse_args(argv)
+    if args.cmd == "snapshot":
+        return cmd_snapshot_status(args)
+    if args.cmd == "hashkv":
+        return cmd_hashkv(args)
+    if args.cmd == "defrag":
+        return cmd_defrag(args)
+    return cmd_status(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1346,10 +1346,17 @@ def node_round(
         # straight-line unrolling lets XLA fuse across messages and the
         # whole round becomes one launch-overhead-free program. Compile
         # time is paid once per (Spec, C) shape and persisted.
+        #
+        # The optimization barrier between steps bounds peak HBM: without
+        # it the scheduler keeps every step's big intermediates (the
+        # one-hot ring-roll matrices are O(L^2 * C)) live at once and the
+        # unrolled program OOMs at fleet C (observed 37G at C=8k); the
+        # barrier makes step i's scratch die before step i+1 allocates.
         n_msgs = spec.M * spec.K + 3
         for i in range(n_msgs):
             m = jax.tree.map(lambda x: x[i], seq)
             n, ob = process_message(cfg, spec, n, ob, m)
+            n, ob = jax.lax.optimization_barrier((n, ob))
     else:
         def body(carry, m):
             nn, oo = carry

@@ -149,7 +149,9 @@ def build_scan_rounds(cfg: RaftConfig, spec: Spec, mesh: Mesh | None, rounds: in
         return state, inbox
 
     if mesh is None:
-        return jax.jit(many)
+        # donate the carried fleet state: the driver never reuses the
+        # previous round's buffers, and at 1M groups they are GBs of HBM
+        return jax.jit(many, donate_argnums=(0, 1))
     if use_shard_map:
         in_specs = fleet_in_specs(cfg, spec)
         fn = shard_map(
@@ -159,10 +161,10 @@ def build_scan_rounds(cfg: RaftConfig, spec: Spec, mesh: Mesh | None, rounds: in
             out_specs=(in_specs[0], in_specs[1]),
             check_rep=False,
         )
-        return jax.jit(fn)
+        return jax.jit(fn, donate_argnums=(0, 1))
 
     def constrained(*args):
         args = tuple(_constrain(mesh, a) for a in args)
         return many(*args)
 
-    return jax.jit(constrained)
+    return jax.jit(constrained, donate_argnums=(0, 1))

@@ -1,0 +1,268 @@
+"""L7 proxy: caching/coalescing front for the v3 API.
+
+The reference's grpcproxy (server/proxy/grpcproxy) multiplexes many
+clients onto one upstream connection: serializable Ranges answer from an
+invalidated cache (grpcproxy/cache/store.go), watches on the same range
+coalesce onto a single upstream watcher that broadcasts events
+(watch_broadcast.go), everything else passes through. tcpproxy is the
+L4 gateway variant.
+
+This serves the same JSON/HTTP surface as etcd_tpu.server.v3rpc and
+forwards to any backing endpoint, adding:
+  * a serializable-Range cache keyed by (key, range_end, limit,
+    count_only), invalidated on any write that touches the range;
+  * watch coalescing: one upstream watch per (key, range_end), events
+    fanned out to every attached client watcher;
+  * passthrough for all other routes.
+
+Usage:
+    python -m etcd_tpu.proxy --endpoint http://127.0.0.1:2379 --port 23790
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _overlaps(akey: bytes, aend: bytes | None, bkey: bytes) -> bool:
+    if aend is None:
+        return akey == bkey
+    if aend == b"\x00":
+        return bkey >= akey
+    return akey <= bkey < aend
+
+
+class RangeCache:
+    """grpcproxy/cache/store.go: an LRU of serializable Range responses,
+    invalidated by overlapping writes."""
+
+    def __init__(self, max_entries: int = 1024):
+        self.max = max_entries
+        self._data: dict[tuple, dict] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple) -> dict | None:
+        with self._lock:
+            res = self._data.get(key)
+            if res is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return res
+
+    def put(self, key: tuple, value: dict) -> None:
+        with self._lock:
+            if len(self._data) >= self.max:
+                self._data.pop(next(iter(self._data)))
+            self._data[key] = value
+
+    def invalidate(self, wkey: bytes, wend: bytes | None = None) -> None:
+        with self._lock:
+            dead = []
+            for (ckey, cend, _lim, _co) in self._data:
+                if wend is None:
+                    if _overlaps(ckey, cend, wkey):
+                        dead.append((ckey, cend, _lim, _co))
+                elif _overlaps(wkey, wend, ckey) or _overlaps(ckey, cend, wkey):
+                    dead.append((ckey, cend, _lim, _co))
+            for k in dead:
+                del self._data[k]
+
+
+class WatchCoalescer:
+    """watch_broadcast.go: one upstream watcher per range, N subscribers."""
+
+    def __init__(self, call):
+        self._call = call
+        self._lock = threading.Lock()
+        self._bcasts: dict[tuple, dict] = {}  # range -> {upstream, subs}
+        self._next_sub = 1
+
+    def create(self, create_request: dict) -> int:
+        rng = (create_request["key"], create_request.get("range_end"))
+        with self._lock:
+            b = self._bcasts.get(rng)
+            if b is None:
+                res = self._call("/v3/watch",
+                                 {"create_request": create_request})
+                b = {"upstream": int(res["watch_id"]), "subs": {}}
+                self._bcasts[rng] = b
+            sid = self._next_sub
+            self._next_sub += 1
+            b["subs"][sid] = []
+            return sid
+
+    def poll(self, sub_id: int) -> list[dict]:
+        with self._lock:
+            for rng, b in self._bcasts.items():
+                if sub_id in b["subs"]:
+                    res = self._call(
+                        "/v3/watch",
+                        {"poll_request": {"watch_id": str(b["upstream"])}},
+                    )
+                    evs = res.get("events", [])
+                    if evs:  # broadcast to every subscriber's buffer
+                        for q in b["subs"].values():
+                            q.extend(evs)
+                    out = b["subs"][sub_id]
+                    b["subs"][sub_id] = []
+                    return out
+            return []
+
+    def cancel(self, sub_id: int) -> bool:
+        with self._lock:
+            for rng, b in list(self._bcasts.items()):
+                if sub_id in b["subs"]:
+                    del b["subs"][sub_id]
+                    if not b["subs"]:  # last subscriber: drop upstream
+                        self._call(
+                            "/v3/watch",
+                            {"cancel_request": {
+                                "watch_id": str(b["upstream"])}},
+                        )
+                        del self._bcasts[rng]
+                    return True
+        return False
+
+
+class Proxy:
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint.rstrip("/")
+        self.cache = RangeCache()
+        self.watches = WatchCoalescer(self.call)
+
+    def call(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.endpoint + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())
+
+    def handle(self, path: str, q: dict) -> dict:
+        if path == "/v3/kv/range" and q.get("serializable"):
+            ck = (
+                base64.b64decode(q["key"]),
+                base64.b64decode(q["range_end"]) if q.get("range_end")
+                else None,
+                q.get("limit", 0), bool(q.get("count_only")),
+            )
+            cached = self.cache.get(ck)
+            if cached is not None:
+                return cached
+            res = self.call(path, q)
+            self.cache.put(ck, res)
+            return res
+        if path in ("/v3/kv/put", "/v3/kv/deleterange"):
+            self.cache.invalidate(
+                base64.b64decode(q["key"]),
+                base64.b64decode(q["range_end"]) if q.get("range_end")
+                else None,
+            )
+            return self.call(path, q)
+        if path == "/v3/kv/txn":
+            # conservative: any txn invalidates everything it might touch
+            for op in q.get("success", []) + q.get("failure", []):
+                body = op.get("request_put") or op.get("request_delete_range")
+                if body:
+                    self.cache.invalidate(
+                        base64.b64decode(body["key"]),
+                        base64.b64decode(body["range_end"])
+                        if body.get("range_end") else None,
+                    )
+            return self.call(path, q)
+        if path == "/v3/watch":
+            if "create_request" in q:
+                sid = self.watches.create(q["create_request"])
+                return {"created": True, "watch_id": str(sid)}
+            if "poll_request" in q:
+                sid = int(q["poll_request"]["watch_id"])
+                return {"watch_id": str(sid),
+                        "events": self.watches.poll(sid)}
+            if "cancel_request" in q:
+                sid = int(q["cancel_request"]["watch_id"])
+                return {"canceled": self.watches.cancel(sid),
+                        "watch_id": str(sid)}
+        return self.call(path, q)
+
+
+class ProxyServer:
+    def __init__(self, endpoint: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        proxy = Proxy(endpoint)
+        self.proxy = proxy
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, obj: dict) -> None:
+                blob = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                try:
+                    with urllib.request.urlopen(
+                        proxy.endpoint + self.path
+                    ) as r:
+                        blob = r.read()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                except urllib.error.HTTPError as e:
+                    self._send(e.code, {"error": str(e)})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                q = json.loads(self.rfile.read(n) or b"{}")
+                try:
+                    self._send(200, proxy.handle(self.path, q))
+                except urllib.error.HTTPError as e:
+                    self._send(e.code, json.loads(e.read() or b"{}"))
+                except Exception as e:  # pragma: no cover
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    def start(self) -> "ProxyServer":
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="etcd-tpu-proxy")
+    p.add_argument("--endpoint", default="http://127.0.0.1:2379")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=23790)
+    args = p.parse_args(argv)
+    srv = ProxyServer(args.endpoint, args.host, args.port).start()
+    print(f"proxying :{srv.port} -> {args.endpoint}", file=sys.stderr)
+    import signal
+
+    signal.sigwait({signal.SIGINT, signal.SIGTERM})
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,9 +171,19 @@ class EtcdCluster:
             raise ErrNoLeader()
         return lead
 
-    def tick(self) -> None:
+    def tick(self, lease_clock: bool = True) -> None:
+        """One raft tick. `lease_clock=False` advances only the raft
+        timers: lease/auth TTLs are denominated in SECONDS like the
+        reference (lease/lessor.go), so a sub-second raft ticker (e.g.
+        embed's 100ms loop) must advance the lease clock on a 1s cadence,
+        not per raft tick."""
         self.cl.step(tick=True)
         self._pump()
+        if lease_clock:
+            self.advance_lease_clock()
+
+    def advance_lease_clock(self) -> None:
+        """One lease-clock second: TTL countdowns + expiry proposals."""
         for ms in self.members:
             ms.lessor.tick()
             ms.auth.tick()

@@ -1,0 +1,542 @@
+"""Network façade: the v3 API served over JSON/HTTP.
+
+The reference serves clients gRPC (server/etcdserver/api/v3rpc/grpc.go:39)
+plus a JSON/HTTP mapping of the exact same services via the gRPC gateway
+(api/etcdserverpb/rpc.proto's google.api.http annotations: /v3/kv/range,
+/v3/kv/put, /v3/lease/grant, ...), and a plain-HTTP sidecar for
+/health, /version and /metrics (api/etcdhttp). The TPU build serves the
+gateway mapping directly — same paths, same JSON field conventions
+(bytes base64-encoded, int64s as strings accepted) — over a threaded
+stdlib HTTP server; one process-wide lock serializes access to the
+EtcdCluster, mirroring the reference's single apply loop.
+
+Streams: gRPC's bidi Watch/LeaseKeepAlive become create/poll/cancel
+POSTs (a long-poll gateway, the same shape the reference's gateway
+emulates with chunked JSON frames).
+
+Election/Lock: the v3election/v3lock services
+(server/etcdserver/api/v3election, v3lock) are served on their gateway
+paths, implemented over the same concurrency recipes the client library
+uses, bound to the caller's lease.
+"""
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from etcd_tpu.client import Client, prefix_range_end
+from etcd_tpu.concurrency import Election, Mutex, Session
+from etcd_tpu.server.kvserver import Compare, EtcdCluster, Op, ServerError
+
+__version__ = "3.5.0-tpu.2"
+
+
+def _b64(b: bytes | None) -> str | None:
+    return base64.b64encode(b).decode() if b is not None else None
+
+
+def _unb64(s: str | None) -> bytes | None:
+    return base64.b64decode(s) if s is not None else None
+
+
+def _int(v, default=0) -> int:
+    if v is None:
+        return default
+    return int(v)  # the gateway accepts int64 as JSON string
+
+
+def _kv_json(kv) -> dict:
+    return {
+        "key": _b64(kv.key),
+        "value": _b64(kv.value),
+        "create_revision": str(kv.create_revision),
+        "mod_revision": str(kv.mod_revision),
+        "version": str(kv.version),
+        "lease": str(kv.lease),
+    }
+
+
+def _header_json(h) -> dict:
+    return {
+        "cluster_id": "1", "member_id": str(h.member_id),
+        "revision": str(h.revision), "raft_term": str(h.raft_term),
+    }
+
+
+class _BoundSession(Session):
+    """A Session over a caller-provided lease (v3election campaign takes
+    the lease id on the wire, v3election/v3electionpb)."""
+
+    def __init__(self, client: Client, lease_id: int):
+        self.client = client
+        self.lease_id = lease_id
+
+
+class V3Api:
+    """Request-level service implementation, transport-free (the analog
+    of the v3rpc service structs); V3Server wires it to HTTP."""
+
+    def __init__(self, ec: EtcdCluster):
+        self.ec = ec
+        self.lock = threading.RLock()
+        self._watch_member = 0
+
+    # -- kv ------------------------------------------------------------------
+    def kv_range(self, q: dict) -> dict:
+        kvs = self.ec.range(
+            _unb64(q["key"]),
+            _unb64(q.get("range_end")),
+            rev=_int(q.get("revision")),
+            limit=_int(q.get("limit")),
+            serializable=bool(q.get("serializable")),
+            count_only=bool(q.get("count_only")),
+            token=q.get("_token"),
+        )
+        return {
+            "header": _header_json(kvs["header"]),
+            "kvs": [_kv_json(kv) for kv in kvs["kvs"]],
+            "count": str(kvs["count"]),
+        }
+
+    def _header(self) -> dict:
+        return _header_json(self.ec._header(self.ec.ensure_leader()))
+
+    def kv_put(self, q: dict) -> dict:
+        res = self.ec.put(
+            _unb64(q["key"]), _unb64(q.get("value")) or b"",
+            lease=_int(q.get("lease")),
+            prev_kv=bool(q.get("prev_kv")),
+            token=q.get("_token"),
+        )
+        out = {"header": self._header()}
+        if res.get("prev_kv"):
+            out["prev_kv"] = _kv_json(res["prev_kv"])
+        return out
+
+    def kv_deleterange(self, q: dict) -> dict:
+        res = self.ec.delete_range(
+            _unb64(q["key"]), _unb64(q.get("range_end")),
+            prev_kv=bool(q.get("prev_kv")),
+            token=q.get("_token"),
+        )
+        out = {
+            "header": self._header(),
+            "deleted": str(res["deleted"]),
+        }
+        if res.get("prev_kvs"):
+            out["prev_kvs"] = [_kv_json(kv) for kv in res["prev_kvs"]]
+        return out
+
+    def _parse_op(self, j: dict) -> Op:
+        if "request_put" in j:
+            p = j["request_put"]
+            return Op("put", _unb64(p["key"]), _unb64(p.get("value")) or b"",
+                      lease=_int(p.get("lease")))
+        if "request_delete_range" in j:
+            p = j["request_delete_range"]
+            return Op("delete", _unb64(p["key"]), range_end=_unb64(p.get("range_end")))
+        if "request_range" in j:
+            p = j["request_range"]
+            return Op("range", _unb64(p["key"]),
+                      range_end=_unb64(p.get("range_end")),
+                      rev=_int(p.get("revision")), limit=_int(p.get("limit")))
+        raise ServerError("unsupported txn op")
+
+    def _parse_cmp(self, j: dict) -> Compare:
+        target = j.get("target", "VALUE").lower()
+        result = {"EQUAL": "=", "GREATER": ">", "LESS": "<",
+                  "NOT_EQUAL": "!="}[j.get("result", "EQUAL")]
+        key = _unb64(j["key"])
+        if target == "value":
+            return Compare(key, "value", result, _unb64(j.get("value")) or b"")
+        field = {"version": "version", "create": "create", "mod": "mod",
+                 "lease": "lease"}[target]
+        val = _int(j.get(field if field != "create" else "create_revision",
+                         j.get(field + "_revision", j.get(field))))
+        return Compare(key, field, result, val)
+
+    def kv_txn(self, q: dict) -> dict:
+        res = self.ec.txn(
+            [self._parse_cmp(c) for c in q.get("compare", [])],
+            [self._parse_op(o) for o in q.get("success", [])],
+            [self._parse_op(o) for o in q.get("failure", [])],
+            token=q.get("_token"),
+        )
+        responses = []
+        for kind, payload in res["responses"]:
+            if kind == "put":
+                responses.append({"response_put": {"header": {}}})
+            elif kind == "delete":
+                responses.append(
+                    {"response_delete_range": {"deleted": str(payload)}}
+                )
+            else:
+                responses.append({
+                    "response_range": {
+                        "kvs": [_kv_json(kv) for kv in payload[0]],
+                        "count": str(payload[1]),
+                    }
+                })
+        return {
+            "header": self._header(),
+            "succeeded": res["succeeded"],
+            "responses": responses,
+        }
+
+    def kv_compaction(self, q: dict) -> dict:
+        self.ec.compact(_int(q.get("revision")))
+        return {"header": {}}
+
+    # -- watch (create/poll/cancel long-poll mapping) ------------------------
+    def watch(self, q: dict) -> dict:
+        if "create_request" in q:
+            c = q["create_request"]
+            w = self.ec.watch(
+                self._watch_member,
+                _unb64(c["key"]), _unb64(c.get("range_end")),
+                start_rev=_int(c.get("start_revision")),
+                prev_kv=bool(c.get("prev_kv")),
+            )
+            return {"created": True, "watch_id": str(w.id)}
+        if "poll_request" in q:
+            wid = _int(q["poll_request"]["watch_id"])
+            evs = self.ec.watch_events(self._watch_member, wid)
+            return {
+                "watch_id": str(wid),
+                "events": [
+                    {
+                        "type": "PUT" if e.type == "put" else "DELETE",
+                        "kv": _kv_json(e.kv),
+                        **({"prev_kv": _kv_json(e.prev_kv)}
+                           if e.prev_kv else {}),
+                    }
+                    for e in evs
+                ],
+            }
+        if "cancel_request" in q:
+            wid = _int(q["cancel_request"]["watch_id"])
+            return {"canceled": self.ec.cancel_watch(self._watch_member, wid),
+                    "watch_id": str(wid)}
+        raise ServerError("watch: need create/poll/cancel request")
+
+    # -- lease ---------------------------------------------------------------
+    def lease_grant(self, q: dict) -> dict:
+        res = self.ec.lease_grant(_int(q.get("ID")), _int(q.get("TTL")))
+        return {"ID": str(res["id"]), "TTL": str(res["ttl"]), "header": {}}
+
+    def lease_revoke(self, q: dict) -> dict:
+        self.ec.lease_revoke(_int(q.get("ID")))
+        return {"header": {}}
+
+    def lease_keepalive(self, q: dict) -> dict:
+        res = self.ec.lease_keepalive(_int(q.get("ID")))
+        return {"ID": str(res["id"]), "TTL": str(res["ttl"]), "header": {}}
+
+    def lease_timetolive(self, q: dict) -> dict:
+        res = self.ec.lease_time_to_live(_int(q.get("ID")))
+        out = {"ID": str(res["id"]), "TTL": str(res["ttl"]),
+               "grantedTTL": str(res.get("granted_ttl", res["ttl"])),
+               "header": {}}
+        if q.get("keys"):
+            out["keys"] = [_b64(k) for k in res.get("keys", [])]
+        return out
+
+    def lease_leases(self, q: dict) -> dict:
+        return {"leases": [{"ID": str(i)} for i in self.ec.leases()],
+                "header": {}}
+
+    # -- cluster -------------------------------------------------------------
+    def member_add(self, q: dict) -> dict:
+        mid = _int(q.get("ID"))
+        self.ec.member_add(mid, learner=bool(q.get("is_learner")))
+        return {"header": {}, "member": {"ID": str(mid),
+                                         "is_learner": bool(q.get("is_learner"))}}
+
+    def member_remove(self, q: dict) -> dict:
+        self.ec.member_remove(_int(q.get("ID")))
+        return {"header": {}}
+
+    def member_promote(self, q: dict) -> dict:
+        self.ec.member_promote(_int(q.get("ID")))
+        return {"header": {}}
+
+    def member_list(self, q: dict) -> dict:
+        cfg = self.ec.member_config()
+        return {
+            "header": {},
+            "members": [
+                {"ID": str(i), "is_learner": i in cfg.learners}
+                for i in sorted(cfg.progress)
+            ],
+        }
+
+    # -- maintenance ---------------------------------------------------------
+    def maintenance_status(self, q: dict) -> dict:
+        st = self.ec.status(q.get("_member", self.ec.ensure_leader()))
+        return {**{k: (str(v) if isinstance(v, int) else v)
+                   for k, v in st.items()}, "version": __version__}
+
+    def maintenance_hash_kv(self, q: dict) -> dict:
+        m = q.get("_member", self.ec.ensure_leader())
+        return {"hash": str(self.ec.hash_kv(m, _int(q.get("revision")))),
+                "header": {}}
+
+    def maintenance_alarm(self, q: dict) -> dict:
+        action = q.get("action", "GET")
+        if action == "GET":  # reads don't go through consensus
+            lead = self.ec.ensure_leader()
+            alarms = sorted(self.ec.members[lead].alarms)
+        else:
+            alarms = self.ec.alarm(
+                {"ACTIVATE": "activate", "DEACTIVATE": "deactivate"}[action],
+                q.get("alarm", "NOSPACE"),
+            )
+        return {"header": {}, "alarms": [{"alarm": a} for a in alarms]}
+
+    def maintenance_snapshot(self, q: dict) -> dict:
+        m = q.get("_member", self.ec.ensure_leader())
+        snap = self.ec.member_snapshot(m)
+        # the gateway streams the backend file; we ship the state snapshot
+        return {"blob": _b64(json.dumps(_jsonable(snap)).encode())}
+
+    def maintenance_defragment(self, q: dict) -> dict:
+        for ms in self.ec.members:
+            if ms.backend is not None:
+                ms.backend.defrag()
+        return {"header": {}}
+
+    # -- auth ----------------------------------------------------------------
+    # gateway path suffix -> replicated auth request kind
+    AUTH_OPS = {
+        "enable": "auth_enable",
+        "disable": "auth_disable",
+        "user_add": "auth_user_add",
+        "user_delete": "auth_user_delete",
+        "user_changepw": "auth_user_change_password",
+        "user_grant": "auth_user_grant_role",
+        "user_revoke": "auth_user_revoke_role",
+        "role_add": "auth_role_add",
+        "role_delete": "auth_role_delete",
+        "role_grant": "auth_role_grant_permission",
+        "role_revoke": "auth_role_revoke_permission",
+    }
+
+    def auth(self, suffix: str, q: dict) -> dict:
+        q.pop("_token", None)
+        if suffix == "authenticate":
+            tok = self.ec.authenticate(q["name"], q["password"])
+            return {"token": tok, "header": {}}
+        kind = self.AUTH_OPS.get(suffix)
+        if kind is None:
+            raise ServerError(f"unknown auth op {suffix}")
+        kw = {k: v for k, v in q.items()}
+        if kind == "auth_role_grant_permission":
+            from etcd_tpu.server.auth import Permission
+
+            p = kw.pop("perm")
+            ptype = {"READ": 0, "WRITE": 1, "READWRITE": 2}[
+                p.get("permType", "READWRITE")
+            ]
+            kw["role"] = kw.pop("name", kw.get("role"))
+            kw["perm"] = Permission(
+                ptype, _unb64(p["key"]), _unb64(p.get("range_end"))
+            )
+        if "key" in kw and isinstance(kw["key"], str):
+            kw["key"] = _unb64(kw["key"])
+        if "range_end" in kw and isinstance(kw["range_end"], str):
+            kw["range_end"] = _unb64(kw["range_end"])
+        res = self.ec.auth_request(kind, **kw)
+        return {"header": {}, "result": _jsonable(res)}
+
+    # -- election / lock (api/v3election, api/v3lock) ------------------------
+    def _session(self, lease: int) -> Session:
+        return _BoundSession(Client(self.ec), lease)
+
+    def election_campaign(self, q: dict) -> dict:
+        name = _unb64(q["name"])
+        e = Election(self._session(_int(q.get("lease"))), name)
+        e.campaign(_unb64(q.get("value")) or b"")
+        return {
+            "header": {},
+            "leader": {"name": _b64(name), "key": _b64(e.my_key),
+                       "rev": str(e.my_rev), "lease": q.get("lease")},
+        }
+
+    def election_proclaim(self, q: dict) -> dict:
+        l = q["leader"]
+        e = Election(self._session(_int(l.get("lease"))),
+                     _unb64(l["name"]))
+        e.my_key, e.my_rev = _unb64(l["key"]), _int(l.get("rev"))
+        e.proclaim(_unb64(q.get("value")) or b"")
+        return {"header": {}}
+
+    def election_leader(self, q: dict) -> dict:
+        e = Election(self._session(0), _unb64(q["name"]))
+        kv = e.leader()
+        if kv is None:
+            raise ServerError("election: no leader")
+        return {"header": {}, "kv": _kv_json(kv)}
+
+    def election_resign(self, q: dict) -> dict:
+        l = q["leader"]
+        e = Election(self._session(_int(l.get("lease"))), _unb64(l["name"]))
+        e.my_key, e.my_rev = _unb64(l["key"]), _int(l.get("rev"))
+        e.resign()
+        return {"header": {}}
+
+    def lock_lock(self, q: dict) -> dict:
+        m = Mutex(self._session(_int(q.get("lease"))), _unb64(q["name"]))
+        m.lock()
+        return {"header": {}, "key": _b64(m.my_key)}
+
+    def lock_unlock(self, q: dict) -> dict:
+        self.ec.delete_range(_unb64(q["key"]))
+        return {"header": {}}
+
+
+def _jsonable(x):
+    if isinstance(x, bytes):
+        return _b64(x)
+    if isinstance(x, dict):
+        return {str(_jsonable(k)): _jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple, set)):
+        return [_jsonable(v) for v in sorted(x) if not isinstance(x, (list, tuple))] \
+            if isinstance(x, set) else [_jsonable(v) for v in x]
+    if hasattr(x, "__dict__"):
+        return _jsonable(vars(x))
+    return x
+
+
+ROUTES = {
+    "/v3/kv/range": "kv_range",
+    "/v3/kv/put": "kv_put",
+    "/v3/kv/deleterange": "kv_deleterange",
+    "/v3/kv/txn": "kv_txn",
+    "/v3/kv/compaction": "kv_compaction",
+    "/v3/watch": "watch",
+    "/v3/lease/grant": "lease_grant",
+    "/v3/lease/revoke": "lease_revoke",
+    "/v3/lease/keepalive": "lease_keepalive",
+    "/v3/lease/timetolive": "lease_timetolive",
+    "/v3/lease/leases": "lease_leases",
+    "/v3/cluster/member/add": "member_add",
+    "/v3/cluster/member/remove": "member_remove",
+    "/v3/cluster/member/promote": "member_promote",
+    "/v3/cluster/member/list": "member_list",
+    "/v3/maintenance/status": "maintenance_status",
+    "/v3/maintenance/hash": "maintenance_hash_kv",
+    "/v3/maintenance/alarm": "maintenance_alarm",
+    "/v3/maintenance/snapshot": "maintenance_snapshot",
+    "/v3/maintenance/defragment": "maintenance_defragment",
+    "/v3/election/campaign": "election_campaign",
+    "/v3/election/proclaim": "election_proclaim",
+    "/v3/election/leader": "election_leader",
+    "/v3/election/resign": "election_resign",
+    "/v3/lock/lock": "lock_lock",
+    "/v3/lock/unlock": "lock_unlock",
+}
+
+
+class V3Server:
+    """HTTP transport wrapper around V3Api + the etcdhttp endpoints."""
+
+    def __init__(self, ec: EtcdCluster, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.api = V3Api(ec)
+        api = self.api
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, obj: dict) -> None:
+                blob = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                # etcdhttp: /health, /version, /metrics (api/etcdhttp)
+                if self.path == "/health":
+                    with api.lock:
+                        try:
+                            api.ec.ensure_leader()
+                            self._send(200, {"health": "true"})
+                        except Exception as e:
+                            self._send(503, {"health": "false",
+                                             "reason": str(e)})
+                elif self.path == "/version":
+                    self._send(200, {"etcdserver": __version__,
+                                     "etcdcluster": "3.5.0"})
+                elif self.path == "/metrics":
+                    from etcd_tpu.models.metrics import fleet_summary
+
+                    with api.lock:
+                        s = fleet_summary(api.ec.cl.s)
+                    lines = [
+                        f"etcd_tpu_groups {s['groups']}",
+                        f"etcd_tpu_groups_with_leader {s['groups_with_leader']}",
+                        f"etcd_tpu_commit_max {s['commit_max']}",
+                        f"etcd_tpu_commit_apply_lag_max {s['commit_apply_lag_max']}",
+                        f"etcd_tpu_term_max {s['term_max']}",
+                    ]
+                    blob = ("\n".join(lines) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    q = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError:
+                    self._send(400, {"error": "bad json", "code": 3})
+                    return
+                tok = self.headers.get("Authorization")
+                if tok:
+                    q["_token"] = tok
+                path = self.path
+                if path.startswith("/v3/auth/"):
+                    suffix = path[len("/v3/auth/"):].replace("/", "_")
+                    with api.lock:
+                        try:
+                            self._send(200, api.auth(suffix, q))
+                        except Exception as e:
+                            self._send(400, {"error": str(e), "code": 3})
+                    return
+                name = ROUTES.get(path)
+                if name is None:
+                    self._send(404, {"error": f"unknown path {path}"})
+                    return
+                with api.lock:
+                    try:
+                        self._send(200, getattr(api, name)(q))
+                    except ServerError as e:
+                        self._send(400, {"error": str(e), "code": 3})
+                    except Exception as e:  # pragma: no cover
+                        self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "V3Server":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

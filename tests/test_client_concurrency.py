@@ -79,7 +79,11 @@ def test_mutex_released_by_session_expiry(cli):
     s2 = Session(cli, ttl=60)
     m2 = Mutex(s2, b"locks/y")
     assert not m2.try_lock()
-    # s1's lease expires (no keepalive) -> key deleted -> m2 acquires
+    # s1's lease expires (no keepalive) -> key deleted -> m2 acquires.
+    # The lock-wait loop deliberately does NOT advance the lease clock
+    # (that would fast-forward every session's TTL), so pass time here.
+    for _ in range(5):
+        cli.ec.tick()
     m2.lock(max_rounds=30)
     assert m2.is_owner()
     m2.unlock()

@@ -1,0 +1,217 @@
+"""Serving-layer tests: embed + v3 JSON/HTTP API + etcdctl/etcdutl/verify.
+
+The reference covers this tier with tests/e2e (real binaries over real
+sockets driven by etcdctl); here an embedded server (etcd_tpu.embed)
+serves real HTTP on localhost and the CLI tools drive it through the
+wire, then the offline tools check the data dir it wrote.
+"""
+import base64
+import io
+import json
+import sys
+import urllib.request
+
+import pytest
+
+from etcd_tpu import etcdctl, etcdutl, verify
+from etcd_tpu.embed import Config, start_etcd
+
+
+def b64(s: bytes | str) -> str:
+    if isinstance(s, str):
+        s = s.encode()
+    return base64.b64encode(s).decode()
+
+
+@pytest.fixture(scope="module")
+def etcd(tmp_path_factory):
+    cfg = Config(
+        cluster_size=3,
+        data_dir=str(tmp_path_factory.mktemp("embed")),
+        auto_tick=False,
+    )
+    e = start_etcd(cfg)
+    yield e
+    e.close()
+
+
+def call(etcd, path, body):
+    req = urllib.request.Request(
+        etcd.client_url + path,
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read())
+
+
+def run_ctl(etcd, *argv) -> str:
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        rc = etcdctl.main(["--endpoint", etcd.client_url, *argv])
+    finally:
+        sys.stdout = old
+    assert rc == 0
+    return out.getvalue()
+
+
+def test_http_kv_roundtrip(etcd):
+    res = call(etcd, "/v3/kv/put", {"key": b64("wire/k"), "value": b64("v1")})
+    assert "header" in res
+    res = call(etcd, "/v3/kv/range", {"key": b64("wire/k")})
+    assert base64.b64decode(res["kvs"][0]["value"]) == b"v1"
+    assert res["count"] == "1"
+
+
+def test_http_txn_and_compaction(etcd):
+    call(etcd, "/v3/kv/put", {"key": b64("wire/t"), "value": b64("a")})
+    res = call(etcd, "/v3/kv/txn", {
+        "compare": [{"key": b64("wire/t"), "target": "VALUE",
+                     "result": "EQUAL", "value": b64("a")}],
+        "success": [{"request_put": {"key": b64("wire/t"),
+                                     "value": b64("b")}}],
+        "failure": [{"request_range": {"key": b64("wire/t")}}],
+    })
+    assert res["succeeded"] is True
+    res = call(etcd, "/v3/kv/range", {"key": b64("wire/t")})
+    assert base64.b64decode(res["kvs"][0]["value"]) == b"b"
+    rev = int(res["kvs"][0]["mod_revision"])
+    call(etcd, "/v3/kv/compaction", {"revision": rev - 1})
+
+
+def test_http_watch_longpoll(etcd):
+    res = call(etcd, "/v3/watch",
+               {"create_request": {"key": b64("wire/w"),
+                                   "range_end": b64("wire/w\xff")}})
+    wid = res["watch_id"]
+    call(etcd, "/v3/kv/put", {"key": b64("wire/w1"), "value": b64("x")})
+    # watched range is wire/w .. wire/w\xff: w1 is inside
+    res = call(etcd, "/v3/watch", {"poll_request": {"watch_id": wid}})
+    assert [e["type"] for e in res["events"]] == ["PUT"]
+    res = call(etcd, "/v3/watch", {"cancel_request": {"watch_id": wid}})
+    assert res["canceled"] is True
+
+
+def test_http_lease_cycle(etcd):
+    call(etcd, "/v3/lease/grant", {"ID": 501, "TTL": 30})
+    call(etcd, "/v3/kv/put", {"key": b64("wire/l"), "value": b64("x"),
+                              "lease": 501})
+    res = call(etcd, "/v3/lease/timetolive", {"ID": 501})
+    assert int(res["TTL"]) > 0
+    res = call(etcd, "/v3/lease/leases", {})
+    assert {"ID": "501"} in res["leases"]
+    call(etcd, "/v3/lease/revoke", {"ID": 501})
+    res = call(etcd, "/v3/kv/range", {"key": b64("wire/l")})
+    assert res.get("kvs", []) == []  # revoke deleted the attached key
+
+
+def test_http_health_version_metrics_status(etcd):
+    with urllib.request.urlopen(etcd.client_url + "/health") as r:
+        assert json.loads(r.read())["health"] == "true"
+    with urllib.request.urlopen(etcd.client_url + "/version") as r:
+        assert "etcdserver" in json.loads(r.read())
+    with urllib.request.urlopen(etcd.client_url + "/metrics") as r:
+        text = r.read().decode()
+    assert "etcd_tpu_groups_with_leader 1" in text
+    res = call(etcd, "/v3/maintenance/status", {})
+    assert int(res["raft_term"]) >= 1
+    res = call(etcd, "/v3/maintenance/hash", {})
+    assert int(res["hash"]) != 0
+
+
+def test_http_election_and_lock(etcd):
+    call(etcd, "/v3/lease/grant", {"ID": 601, "TTL": 60})
+    res = call(etcd, "/v3/election/campaign",
+               {"name": b64("wire/elec"), "value": b64("cand-1"),
+                "lease": 601})
+    leader = res["leader"]
+    res = call(etcd, "/v3/election/leader", {"name": b64("wire/elec")})
+    assert base64.b64decode(res["kv"]["value"]) == b"cand-1"
+    call(etcd, "/v3/election/resign", {"leader": leader})
+
+    call(etcd, "/v3/lease/grant", {"ID": 602, "TTL": 60})
+    res = call(etcd, "/v3/lock/lock", {"name": b64("wire/lock"),
+                                       "lease": 602})
+    call(etcd, "/v3/lock/unlock", {"key": res["key"]})
+
+
+def test_etcdctl_surface(etcd, tmp_path):
+    assert run_ctl(etcd, "put", "ctl/a", "1") == "OK\n"
+    assert run_ctl(etcd, "get", "ctl/a") == "ctl/a\n1\n"
+    run_ctl(etcd, "put", "ctl/b", "2")
+    out = run_ctl(etcd, "get", "ctl", "--prefix", "--count-only")
+    assert out.strip() == "2"
+    assert run_ctl(etcd, "del", "ctl/b").strip() == "1"
+    out = run_ctl(etcd, "lease", "grant", "701", "60")
+    assert "granted" in out
+    out = run_ctl(etcd, "member", "list")
+    assert out.count("voter") == 3
+    out = run_ctl(etcd, "endpoint", "health")
+    assert "true" in out
+    out = run_ctl(etcd, "alarm", "list")
+    assert out == ""
+    snap_path = str(tmp_path / "snap.json")
+    run_ctl(etcd, "snapshot", "save", snap_path)
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        assert etcdutl.main(["snapshot", "status", snap_path]) == 0
+    finally:
+        sys.stdout = old
+    assert json.loads(out.getvalue())["revision"] >= 1
+
+
+def test_offline_tools_on_data_dir(etcd):
+    # flush whatever is pending so the offline view is current
+    for ms in etcd.server.members:
+        if ms.backend is not None:
+            ms.backend.commit()
+    data_dir = etcd.config.data_dir
+    reports = verify.verify_data_dir(data_dir)
+    assert len(reports) == 3
+    assert all(r["consistent_index"] > 0 for r in reports)
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        assert etcdutl.main(["status", "--data-dir", data_dir]) == 0
+        assert etcdutl.main(["hashkv", "--data-dir", data_dir,
+                             "--member", "0"]) == 0
+        assert etcdutl.main(["defrag", "--data-dir", data_dir]) == 0
+    finally:
+        sys.stdout = old
+    assert "consistent_index" in out.getvalue()
+
+
+def test_auto_compaction_revision_mode(tmp_path):
+    e = start_etcd(Config(cluster_size=3, auto_tick=False,
+                          auto_compaction_mode="revision",
+                          auto_compaction_retention=5))
+    try:
+        for i in range(12):
+            call(e, "/v3/kv/put", {"key": b64("c/k"), "value": b64(str(i))})
+        for _ in range(12):
+            e.tick()
+        lead = e.server.ensure_leader()
+        kv = e.server.members[lead].store.kv
+        assert kv.compact_rev > 0
+        assert kv.current_rev - kv.compact_rev >= 5
+    finally:
+        e.close()
+
+
+def test_ticker_thread_mode():
+    import time
+
+    e = start_etcd(Config(cluster_size=3, tick_ms=20, auto_tick=True))
+    try:
+        call(e, "/v3/kv/put", {"key": b64("t/k"), "value": b64("v")})
+        time.sleep(0.3)  # a few background ticks with concurrent serving
+        res = call(e, "/v3/kv/range", {"key": b64("t/k")})
+        assert base64.b64decode(res["kvs"][0]["value"]) == b"v"
+    finally:
+        e.close()
