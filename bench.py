@@ -58,11 +58,23 @@ def main() -> None:
     # server/etcdserver/raft.go:33-38). unroll_messages: the lax.scan
     # while-loop costs ~10-25ms of fixed runtime per message on TPU, so the
     # perf path runs the straight-line unrolled round program.
-    spec = Spec(M=5, L=32, E=1, K=2, W=4, R=2, A=2)
-    # BENCH_UNROLL=0 keeps the lax.scan round (fast compile) for smoke
-    # runs off-TPU; the perf path default is the unrolled program.
-    unroll = os.environ.get("BENCH_UNROLL", "1" if on_accel else "0") != "0"
-    cfg = RaftConfig(pre_vote=True, check_quorum=True, unroll_messages=unroll)
+    # BENCH_L trims the log ring for the 1M-group configuration: state is
+    # ring-dominated (~3KB/cluster at L=32), and the steady state needs
+    # only enough ring for the commit->apply pipeline (L > 2E + lag).
+    L = int(os.environ.get("BENCH_L", "32"))
+    W = int(os.environ.get("BENCH_W", "4"))
+    spec = Spec(M=5, L=L, E=1, K=2, W=W, R=2, A=2)
+    # Default to the lax.scan round program. Profiling the unrolled
+    # variant on hardware (bench_trace) showed its compile-memory fix
+    # (per-step optimization barriers) shatters the round into ~13k
+    # unfusable small ops whose fixed per-op runtime overhead dominates;
+    # the scan form runs the same math with ~13 while iterations per
+    # round, and since per-round overhead is independent of C the
+    # throughput path is batch scale, not unrolling. BENCH_UNROLL=1
+    # opts back into the unrolled program.
+    unroll = os.environ.get("BENCH_UNROLL", "0") != "0"
+    cfg = RaftConfig(pre_vote=True, check_quorum=True,
+                     unroll_messages=unroll, max_inflight=min(4, W))
     M, E = spec.M, spec.E
 
     devs = jax.devices()

@@ -32,7 +32,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--auto-compaction-mode", default="off",
                    choices=("off", "periodic", "revision"))
     p.add_argument("--auto-compaction-retention", type=int, default=0)
-    p.add_argument("--pre-vote", action="store_true", default=True)
+    p.add_argument("--pre-vote", action=argparse.BooleanOptionalAction,
+                   default=True)
     return p
 
 
@@ -72,12 +73,9 @@ def main(argv=None) -> int:
     etcd = start_etcd(cfg)
     print(f"etcd-tpu '{cfg.name}' serving {etcd.client_url} "
           f"({cfg.cluster_size} members)", file=sys.stderr)
-    stop = []
-    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
-    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     try:
-        while not stop:
-            signal.pause()
+        # race-free: sigwait atomically blocks for either signal
+        signal.sigwait({signal.SIGINT, signal.SIGTERM})
     finally:
         etcd.close()
     return 0

@@ -72,33 +72,33 @@ def check_invariants(state: NodeState, prev_commit: jnp.ndarray,
     )
 
 
-def _merge_delayed(out: Msg, held: Msg, delay_mask) -> tuple[Msg, Msg]:
+def _merge_delayed(spec: Spec, out: Msg, held: Msg,
+                   delay_mask) -> tuple[Msg, Msg]:
     """Split this round's traffic by the delay mask and merge in messages
     held from the previous round. A held message wins a slot collision
     (the fresh one drops — legal per the transport contract,
-    etcdserver/raft.go:107-110)."""
-    dm = delay_mask  # [to, from, K, C] bool
+    etcdserver/raft.go:107-110). Message leaves are in the engine's FLAT
+    storage form [from, K*to(*E), C]; `delay_mask` is [from, K*to, C]."""
+
+    def bc(mask, leaf):
+        if leaf.shape[1] != mask.shape[1]:  # ent leaf: repeat per entry
+            return jnp.repeat(mask, spec.E, axis=1)
+        return mask
+
+    dm = delay_mask
     new_held = jax.tree.map(
-        lambda x: jnp.where(_bc(dm, x), x, jnp.zeros_like(x)), out
+        lambda x: jnp.where(bc(dm, x), x, jnp.zeros_like(x)), out
     )
     new_held = new_held.replace(type=jnp.where(dm, out.type, 0))
     fresh = out.replace(type=jnp.where(dm, 0, out.type))
     held_live = held.type != 0
     merged = jax.tree.map(
-        lambda h, f: jnp.where(_bc(held_live, h), h, f), held, fresh
+        lambda h, f: jnp.where(bc(held_live, h), h, f), held, fresh
     )
     merged = merged.replace(
         type=jnp.where(held_live, held.type, fresh.type)
     )
     return merged, new_held
-
-
-def _bc(mask, leaf):
-    """Broadcast a [to, from, K, C] mask onto a message leaf that may have
-    an extra E axis before C."""
-    if leaf.ndim == mask.ndim + 1:
-        return mask[:, :, :, None, :]
-    return mask
 
 
 def build_chaos_epoch(
@@ -155,9 +155,9 @@ def build_chaos_epoch(
                 state, inbox, prop_len, prop_data, zp, z2, no, do_tick, keep
             )
             delay = jax.random.bernoulli(
-                kl, delay_p, (M, M, spec.K, C)
+                kl, delay_p, (M, spec.K * M, C)
             ) & (out.type != 0)
-            nxt, held2 = _merge_delayed(out, held, delay)
+            nxt, held2 = _merge_delayed(spec, out, held, delay)
             viol = check_invariants(state, prev_commit, viol)
             return (state, nxt, held2, key, viol, state.commit), None
 

@@ -8,7 +8,7 @@ All C clusters advance in lockstep; the per-link fault state is the
 engine's keep-mask.
 
 Layout note: the fleet is clusters-minor — every state leaf is
-``[M, feature..., C]``, inbox leaves ``[to, from, K, (E,) C]``, the
+``[M, feature..., C]``, inbox leaves ``[from, K, to, (E,) C]``, the
 keep-mask ``[from, to, C]``. Host-side accessors below take (m, c) and
 index ``leaf[m, ..., c]``.
 """
@@ -158,14 +158,23 @@ class Cluster:
         """One cluster's view of a state leaf, members leading: [M, ...]."""
         return np.asarray(getattr(self.eng.state, field)[..., c])
 
+    def _slot(self, to: int, slot: int, ent: bool = False):
+        """Index into the flat inbox middle axis (engine.empty_inbox)."""
+        base = slot * self.spec.M + to
+        if ent:
+            return slice(base * self.spec.E, (base + 1) * self.spec.E)
+        return base
+
     def inject(self, to: int, frm: int, c: int = 0, slot: int = 0, **fields):
         """Place a raw message into the pending inbox (delivered next step)."""
+        from etcd_tpu.models.engine import _ENT_FIELDS
+
         ib = self.eng.inbox
         upd = {}
         fields.setdefault("frm", frm)
         for k, v in fields.items():
             leaf = np.array(getattr(ib, k))
-            leaf[to, frm, slot, ..., c] = v
+            leaf[frm, self._slot(to, slot, k in _ENT_FIELDS), c] = v
             upd[k] = jnp.asarray(leaf)
         self.eng.inbox = ib.replace(**upd)
 
@@ -179,14 +188,23 @@ class Cluster:
 
     def pending(self, c: int = 0):
         """[(to, frm, slot, type), ...] of undelivered messages."""
-        t = np.asarray(self.eng.inbox.type[..., c])
+        M = self.spec.M
+        t = np.asarray(self.eng.inbox.type[..., c])  # [from, K*to]
         out = []
-        for to, frm, k in zip(*np.nonzero(t)):
-            out.append((int(to), int(frm), int(k), int(t[to, frm, k])))
+        for frm, kt in zip(*np.nonzero(t)):
+            out.append(
+                (int(kt % M), int(frm), int(kt // M), int(t[frm, kt]))
+            )
         return out
 
     def msg_field(self, field: str, to: int, frm: int, slot: int = 0, c: int = 0):
-        v = np.asarray(getattr(self.eng.inbox, field)[to, frm, slot, ..., c])
+        from etcd_tpu.models.engine import _ENT_FIELDS
+
+        v = np.asarray(
+            getattr(self.eng.inbox, field)[
+                frm, self._slot(to, slot, field in _ENT_FIELDS), c
+            ]
+        )
         return v.item() if v.ndim == 0 else v
 
     # -- inspection ----------------------------------------------------------

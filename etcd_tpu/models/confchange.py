@@ -144,7 +144,7 @@ def apply_conf_change(cfg, spec, n, ob, data, enable):
     # match, which could falsely advance the commit index.
     now_tracked = n.voters | n.voters_out | n.learners | n.learners_next
     fresh = enable & now_tracked & ~was_tracked
-    zM = jnp.zeros((spec.M,), jnp.int32)
+    ends = n.infl_ends.reshape(spec.M, spec.W)
     n = n.replace(
         match=jnp.where(fresh, 0, n.match),
         next_idx=jnp.where(fresh, jnp.maximum(n.last_index, 1), n.next_idx),
@@ -154,7 +154,7 @@ def apply_conf_change(cfg, spec, n, ob, data, enable):
         recent_active=jnp.where(fresh, True, n.recent_active),
         infl_count=jnp.where(fresh, 0, n.infl_count),
         infl_start=jnp.where(fresh, 0, n.infl_start),
-        infl_ends=jnp.where(fresh[:, None], zM[:, None], n.infl_ends),
+        infl_ends=jnp.where(fresh[:, None], 0, ends).reshape(-1),
     )
 
     # switchToConfig side effects (raft.go:1651-1700)

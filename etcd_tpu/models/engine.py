@@ -4,7 +4,7 @@ The reference runs one goroutine per node and moves messages through
 rafthttp streams (server/etcdserver/api/rafthttp/). Here a fleet of
 ``C x M`` nodes steps in lockstep: ``jax.vmap`` over members then clusters
 turns the per-node round into one fused XLA program, and the "network" is a
-transpose of the dense outbox tensor ``[from, to, K, C] -> [to, from, K, C]``
+transpose of the dense outbox tensor ``[from, K, to, C] -> [to, K, from, C]``
 with a multiplicative keep-mask standing in for drop/partition faults
 (rafttest/network.go:33-64's drop/disconnect semantics; dropping is legal
 per the transport contract, etcdserver/raft.go:107-110).
@@ -26,20 +26,57 @@ import jax.numpy as jnp
 from etcd_tpu.models.raft import node_round
 from etcd_tpu.models.state import NodeState, init_node
 from etcd_tpu.ops.outbox import Outbox
-from etcd_tpu.types import Msg, Spec
+from etcd_tpu.types import ENT_FIELDS, Msg, Spec
 from etcd_tpu.utils.config import RaftConfig
 
 
+_ENT_FIELDS = ENT_FIELDS
+
+
+def _unflatten_inbox(spec: Spec, msgs: Msg) -> Msg:
+    """[from, K*to(*E), C] -> [from, K, to, (E,) C]; a bitcast (row-major
+    adjacent-axis split), no data movement."""
+    M, K, E = spec.M, spec.K, spec.E
+
+    def f(name, x):
+        if name in _ENT_FIELDS:
+            return x.reshape(M, K, M, E, x.shape[-1])
+        return x.reshape(M, K, M, x.shape[-1])
+
+    return Msg(**{k: f(k, getattr(msgs, k)) for k in Msg.__dataclass_fields__})
+
+
+def _flatten_inbox(spec: Spec, msgs: Msg) -> Msg:
+    """Inverse of :func:`_unflatten_inbox`."""
+    M, K, E = spec.M, spec.K, spec.E
+
+    def f(name, x):
+        n = K * M * (E if name in _ENT_FIELDS else 1)
+        return x.reshape(M, n, x.shape[-1])
+
+    return Msg(**{k: f(k, getattr(msgs, k)) for k in Msg.__dataclass_fields__})
+
+
 def empty_inbox(spec: Spec, C: int) -> Msg:
-    """Zeroed inbox [to, from, K, (E,) C]."""
+    """Zeroed inbox, stored FLAT: leaves [from, K*to, C] (ent fields
+    [from, K*to*E, C]).
+
+    Two TPU layout hazards shape this format (measured in the C=65536
+    compile reports): (a) any stored tensor whose minor-most logical dims
+    are tiny (K=2, E=1) gets tile-padded 60-200x, so the flat middle axis
+    keeps a medium dim next to C (<=1.6x pad); (b) delivery must not
+    transpose, so the same tensor the senders write (axis 0 = from) is
+    what receivers consume — build_round unflattens by free reshape and
+    maps receivers over the `to` axis."""
     from etcd_tpu.types import empty_msg
 
-    return jax.tree.map(
-        lambda x: jnp.broadcast_to(
-            x[..., None], (spec.M, spec.M, spec.K) + x.shape + (C,)
-        ),
-        empty_msg(spec),
-    )
+    m = empty_msg(spec)
+
+    def mk(name, x):
+        n = spec.K * spec.M * (spec.E if name in _ENT_FIELDS else 1)
+        return jnp.zeros((spec.M, n, C), x.dtype)
+
+    return Msg(**{k: mk(k, getattr(m, k)) for k in Msg.__dataclass_fields__})
 
 
 def init_fleet(
@@ -80,16 +117,24 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
     ri_ctx, do_hup, do_tick, keep_mask) -> (state, next_inbox).
 
     Shapes (clusters-minor): state/* leaves [M, ..., C]; inbox leaves
-    [M(to), M(from), K, (E,) C]; prop_len/ri_ctx/do_hup/do_tick [M, C];
-    prop_data/prop_type [M, E, C]; keep_mask [M(from), M(to), C] bool
-    (True = deliver).
+    FLAT [M(from), K*M(to)(*E), C] (see empty_inbox);
+    prop_len/ri_ctx/do_hup/do_tick [M, C]; prop_data/prop_type [M, E, C];
+    keep_mask [M(from), M(to), C] bool (True = deliver).
+
+    Delivery is transpose-free: each node reads the fleet message tensor
+    along its `to` axis (the outer vmap maps the inbox over axis 2) and
+    writes its outbox with its own id on axis 0, so the masked outbox IS
+    the next inbox. The old explicit swapaxes materialized multi-GB
+    relayout copies at fleet C (XLA put the tiny K/E axes layout-minor).
 
     with_drop_count: also return the number of emitted messages the
     keep-mask killed this round (for the metrics pipeline).
     """
     node_fn = functools.partial(node_round, cfg, spec)
-    # outer vmap: member axis (leading); inner vmap: cluster axis (minor)
-    vmapped = jax.vmap(jax.vmap(node_fn, in_axes=-1, out_axes=-1))
+    # inner vmap: cluster axis (minor); outer vmap: member axis — state
+    # and inputs on axis 0, the inbox on its `to` axis (2)
+    inner = jax.vmap(node_fn, in_axes=-1, out_axes=-1)
+    vmapped = jax.vmap(inner, in_axes=(0, 2, 0, 0, 0, 0, 0, 0))
 
     def round_fn(
         state: NodeState,
@@ -102,15 +147,19 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
         do_tick,
         keep_mask,
     ):
+        inbox5 = _unflatten_inbox(spec, inbox)  # free reshape
         state, ob = vmapped(
-            state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup, do_tick
+            state, inbox5, prop_len, prop_data, prop_type, ri_ctx, do_hup,
+            do_tick,
         )
-        msgs = ob.msgs  # leaves [from, to, K, (E,) C]
+        # ob.msgs leaves are the per-node flat form batched:
+        # [from, K*to(*E), C] — already the inbox storage format
+        msgs = _unflatten_inbox(spec, ob.msgs)  # [from, K, to, (E,) C] view
         # self-loops (MsgHup-to-self etc.) are local, never subject to faults
         keep = keep_mask | jnp.eye(spec.M, dtype=jnp.bool_)[:, :, None]
         emitted = (msgs.type != 0).sum() if with_drop_count else None
-        msgs = msgs.replace(type=jnp.where(keep[:, :, None, :], msgs.type, 0))
-        next_inbox = jax.tree.map(lambda x: jnp.swapaxes(x, 0, 1), msgs)
+        msgs = msgs.replace(type=jnp.where(keep[:, None, :, :], msgs.type, 0))
+        next_inbox = _flatten_inbox(spec, msgs)  # flat storage form
         if with_drop_count:
             dropped = emitted - (next_inbox.type != 0).sum()
             return state, next_inbox, dropped

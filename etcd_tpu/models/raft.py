@@ -515,11 +515,12 @@ def _ro_add_request(spec, n: NodeState, ctx, frm, enable) -> NodeState:
     can = enable & ~dup & (n.ro_count < spec.R)
     pos = jnp.minimum(n.ro_count, spec.R - 1)
     sel = jnp.arange(spec.R, dtype=jnp.int32) == pos
+    acks = n.ro_acks.reshape(spec.R, spec.M)
     return n.replace(
         ro_ctx=jnp.where(sel & can, ctx, n.ro_ctx),
         ro_index=jnp.where(sel & can, n.commit, n.ro_index),
         ro_from=jnp.where(sel & can, frm, n.ro_from),
-        ro_acks=jnp.where((sel & can)[:, None], False, n.ro_acks),
+        ro_acks=jnp.where((sel & can)[:, None], False, acks).reshape(-1),
         ro_count=n.ro_count + can.astype(jnp.int32),
     )
 
@@ -530,9 +531,10 @@ def _ro_recv_ack(spec, n: NodeState, frm, ctx, enable):
     slot_hot = (n.ro_ctx == ctx) & in_q
     found = enable & slot_hot.any()
     fhot = _ids(spec) == frm
-    acks = n.ro_acks | (slot_hot[:, None] & fhot[None, :] & enable)
+    acks_v = n.ro_acks.reshape(spec.R, spec.M)
+    acks = acks_v | (slot_hot[:, None] & fhot[None, :] & enable)
     row = jnp.where(slot_hot[:, None], acks, False).any(axis=0)
-    return n.replace(ro_acks=acks), found, row
+    return n.replace(ro_acks=acks.reshape(-1)), found, row
 
 
 def _ro_advance_emit(cfg, spec, n: NodeState, ob: Outbox, ctx, enable):
@@ -570,7 +572,7 @@ def _ro_advance_emit(cfg, spec, n: NodeState, ob: Outbox, ctx, enable):
             ro_ctx=roll(n.ro_ctx),
             ro_index=roll(n.ro_index),
             ro_from=roll(n.ro_from),
-            ro_acks=roll(n.ro_acks),
+            ro_acks=roll(n.ro_acks.reshape(spec.R, spec.M)).reshape(-1),
             ro_count=n.ro_count - shift,
         ),
         ob,
@@ -1300,7 +1302,7 @@ def node_round(
     cfg: RaftConfig,
     spec: Spec,
     n: NodeState,
-    inbox: Msg,  # leaves [M, K, ...]
+    inbox: Msg,  # leaves [M(from), K, ...]
     prop_len,    # i32 scalar: entries proposed locally this round
     prop_data,   # i32[E]
     prop_type,   # i32[E]
@@ -1328,33 +1330,31 @@ def node_round(
         context=jnp.asarray(ri_ctx, jnp.int32),
     )
 
+    # NB: the inbox is scanned DIRECTLY (its [K, M] leading axes reshape
+    # to one slot axis for free) and the three synthesized local messages
+    # run as separate inlined steps. Stacking everything into one `seq`
+    # tensor with jnp.concatenate materialized multi-GB padded temps at
+    # fleet C (XLA placed the tiny E axis minor: 5x65536x2x5x1 ->
+    # 2.5GB x3 in the C=65536 compile report); slicing the inbox in
+    # place has no such copy.
+    n, ob = process_message(cfg, spec, n, ob, hup_msg)
+
     flat = jax.tree.map(
         lambda x: x.reshape((spec.M * spec.K,) + x.shape[2:]), inbox
     )
-    seq = jax.tree.map(
-        lambda h, f, p, r: jnp.concatenate(
-            [h[None], f, p[None], r[None]], axis=0
-        ),
-        hup_msg, flat, prop_msg, ri_msg,
-    )
-
     if cfg.unroll_messages:
         # Unrolled message loop: a lax.scan costs one while-loop iteration
         # of fixed runtime overhead (~10-25ms measured on the TPU runtime)
-        # per message — 23 iterations dwarf the actual compute. The
-        # sequence is short and statically bounded (M*K + 3), so
-        # straight-line unrolling lets XLA fuse across messages and the
-        # whole round becomes one launch-overhead-free program. Compile
-        # time is paid once per (Spec, C) shape and persisted.
+        # per message. The sequence is short and statically bounded
+        # (M*K), so straight-line unrolling lets XLA fuse across messages.
         #
         # The optimization barrier between steps bounds peak HBM: without
         # it the scheduler keeps every step's big intermediates (the
         # one-hot ring-roll matrices are O(L^2 * C)) live at once and the
         # unrolled program OOMs at fleet C (observed 37G at C=8k); the
         # barrier makes step i's scratch die before step i+1 allocates.
-        n_msgs = spec.M * spec.K + 3
-        for i in range(n_msgs):
-            m = jax.tree.map(lambda x: x[i], seq)
+        for i in range(spec.M * spec.K):
+            m = jax.tree.map(lambda x: x[i], flat)
             n, ob = process_message(cfg, spec, n, ob, m)
             n, ob = jax.lax.optimization_barrier((n, ob))
     else:
@@ -1363,7 +1363,10 @@ def node_round(
             nn, oo = process_message(cfg, spec, nn, oo, m)
             return (nn, oo), None
 
-        (n, ob), _ = jax.lax.scan(body, (n, ob), seq)
+        (n, ob), _ = jax.lax.scan(body, (n, ob), flat)
+
+    n, ob = process_message(cfg, spec, n, ob, prop_msg)
+    n, ob = process_message(cfg, spec, n, ob, ri_msg)
 
     n, ob = apply_round(cfg, spec, n, ob)
     return n, ob

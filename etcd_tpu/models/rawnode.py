@@ -47,6 +47,7 @@ from etcd_tpu.storage.raftstorage import (
     Storage,
 )
 from etcd_tpu.types import (
+    ENT_FIELDS,
     CAMPAIGN_NONE,
     ENTRY_CONF_CHANGE,
     ENTRY_NORMAL,
@@ -240,14 +241,22 @@ def host_to_device_msg(spec: Spec, hm: HostMsg) -> Msg:
 
 
 def outbox_to_host(spec: Spec, ob: Outbox) -> list[HostMsg]:
-    """Harvest a device Outbox into HostMsgs, destination-major then slot
-    order (the reference emits per-peer in sorted-id order via
-    tracker.Visit, tracker/tracker.go:191-213, so this matches)."""
+    """Harvest a device Outbox (leaves [K, M(dest), ...]) into HostMsgs,
+    destination-major then slot order (the reference emits per-peer in
+    sorted-id order via tracker.Visit, tracker/tracker.go:191-213, so
+    this matches)."""
     counts = np.asarray(ob.counts)
     if counts.sum() == 0:
         return []
-    get = lambda leaf: np.asarray(leaf)
-    f = {k: get(getattr(ob.msgs, k)) for k in (
+    K, M, E = spec.K, spec.M, spec.E
+
+    def get(name):  # flat [K*M(*E)] -> [K, M, (E)] view
+        a = np.asarray(getattr(ob.msgs, name))
+        if name in ENT_FIELDS:
+            return a.reshape(K, M, E)
+        return a.reshape(K, M)
+
+    f = {k: get(k) for k in (
         "type", "term", "frm", "index", "log_term", "commit", "reject",
         "reject_hint", "context", "ent_len", "ent_term", "ent_data",
         "ent_type", "c_voters", "c_voters_out", "c_learners",
@@ -255,30 +264,30 @@ def outbox_to_host(spec: Spec, ob: Outbox) -> list[HostMsg]:
     out: list[HostMsg] = []
     for to in range(spec.M):
         for k in range(int(counts[to])):
-            t = int(f["type"][to, k])
+            t = int(f["type"][k, to])
             if t == MSG_NONE:
                 continue
             ents: tuple[Entry, ...] = ()
-            if int(f["ent_len"][to, k]) > 0:
-                base = int(f["index"][to, k])
+            if int(f["ent_len"][k, to]) > 0:
+                base = int(f["index"][k, to])
                 ents = tuple(
                     Entry(
                         index=base + 1 + j,
-                        term=int(f["ent_term"][to, k, j]),
-                        type=int(f["ent_type"][to, k, j]),
-                        data=int(f["ent_data"][to, k, j]),
+                        term=int(f["ent_term"][k, to, j]),
+                        type=int(f["ent_type"][k, to, j]),
+                        data=int(f["ent_data"][k, to, j]),
                     )
-                    for j in range(int(f["ent_len"][to, k]))
+                    for j in range(int(f["ent_len"][k, to]))
                 )
             snap = None
             if t == MSG_SNAP:
                 ub = lambda w: [bool((int(w) >> i) & 1) for i in range(spec.M)]
                 cs = ConfState.from_masks(
-                    ub(f["c_voters"][to, k]),
-                    ub(f["c_voters_out"][to, k]),
-                    ub(f["c_learners"][to, k]),
-                    ub(f["c_learners_next"][to, k]),
-                    bool(f["reject"][to, k]),
+                    ub(f["c_voters"][k, to]),
+                    ub(f["c_voters_out"][k, to]),
+                    ub(f["c_learners"][k, to]),
+                    ub(f["c_learners_next"][k, to]),
+                    bool(f["reject"][k, to]),
                 )
                 snap = Snapshot(
                     meta=SnapshotMeta(
@@ -290,14 +299,14 @@ def outbox_to_host(spec: Spec, ob: Outbox) -> list[HostMsg]:
                 )
             out.append(
                 HostMsg(
-                    type=t, to=to, frm=int(f["frm"][to, k]),
-                    term=int(f["term"][to, k]),
-                    index=0 if t == MSG_SNAP else int(f["index"][to, k]),
-                    log_term=0 if t == MSG_SNAP else int(f["log_term"][to, k]),
-                    commit=0 if t == MSG_SNAP else int(f["commit"][to, k]),
-                    reject=False if t == MSG_SNAP else bool(f["reject"][to, k]),
-                    reject_hint=int(f["reject_hint"][to, k]),
-                    context=int(f["context"][to, k]),
+                    type=t, to=to, frm=int(f["frm"][k, to]),
+                    term=int(f["term"][k, to]),
+                    index=0 if t == MSG_SNAP else int(f["index"][k, to]),
+                    log_term=0 if t == MSG_SNAP else int(f["log_term"][k, to]),
+                    commit=0 if t == MSG_SNAP else int(f["commit"][k, to]),
+                    reject=False if t == MSG_SNAP else bool(f["reject"][k, to]),
+                    reject_hint=int(f["reject_hint"][k, to]),
+                    context=int(f["context"][k, to]),
                     entries=ents,
                     snapshot=snap,
                 )

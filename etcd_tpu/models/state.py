@@ -79,8 +79,11 @@ class NodeState(struct.PyTreeNode):
     probe_sent: jnp.ndarray   # bool[M]
     pending_snapshot: jnp.ndarray  # i32[M]
     recent_active: jnp.ndarray     # bool[M]
-    # inflights ring (tracker/inflights.go): ends of in-flight MsgApps
-    infl_ends: jnp.ndarray    # i32[M, W]
+    # inflights ring (tracker/inflights.go): ends of in-flight MsgApps.
+    # Stored FLAT [M*W]: rank-2 per-node leaves with tiny minor dims get
+    # tile-padded ~26x once batched to fleet shape (a 1.25GB HLO temp at
+    # C=65536); ops view it as [M, W] via free reshapes.
+    infl_ends: jnp.ndarray    # i32[M*W]
     infl_start: jnp.ndarray   # i32[M]
     infl_count: jnp.ndarray   # i32[M]
 
@@ -104,7 +107,7 @@ class NodeState(struct.PyTreeNode):
     ro_ctx: jnp.ndarray       # i32[R] request ctx ids (0 = empty)
     ro_index: jnp.ndarray     # i32[R] commit index captured at enqueue
     ro_from: jnp.ndarray      # i32[R] requester id (NONE_ID/self => local)
-    ro_acks: jnp.ndarray      # bool[R, M]
+    ro_acks: jnp.ndarray      # bool[R*M] (flat; see infl_ends note)
     ro_count: jnp.ndarray     # i32 number of queued requests
     # pending MsgReadIndex deferred until first commit in term
     # (raft.go:311-315 pendingReadIndexMessages)
@@ -165,7 +168,7 @@ def init_node(
         probe_sent=fM,
         pending_snapshot=jnp.zeros((M,), jnp.int32),
         recent_active=fM,
-        infl_ends=jnp.zeros((M, W), jnp.int32),
+        infl_ends=jnp.zeros((M * W,), jnp.int32),
         infl_start=jnp.zeros((M,), jnp.int32),
         infl_count=jnp.zeros((M,), jnp.int32),
         votes_responded=fM, votes_granted=fM,
@@ -177,7 +180,7 @@ def init_node(
         ro_ctx=jnp.zeros((R,), jnp.int32),
         ro_index=jnp.zeros((R,), jnp.int32),
         ro_from=jnp.full((R,), NONE_ID, jnp.int32),
-        ro_acks=jnp.zeros((R, M), jnp.bool_),
+        ro_acks=jnp.zeros((R * M,), jnp.bool_),
         ro_count=z,
         ro_pend_ctx=jnp.zeros((R,), jnp.int32),
         ro_pend_from=jnp.full((R,), NONE_ID, jnp.int32),

@@ -16,6 +16,11 @@ from etcd_tpu.models.state import NodeState
 from etcd_tpu.types import Spec
 
 
+def _ends(spec: Spec, n: NodeState) -> jnp.ndarray:
+    """[M, W] view of the flat ends buffer (free reshape)."""
+    return n.infl_ends.reshape(spec.M, spec.W)
+
+
 def _valid(spec: Spec, n: NodeState) -> jnp.ndarray:
     """[M, W] bool: which ring positions hold live ends."""
     w = jnp.arange(spec.W, dtype=jnp.int32)[None, :]
@@ -29,15 +34,18 @@ def add(spec: Spec, n: NodeState, mask: jnp.ndarray, end: jnp.ndarray) -> NodeSt
     w = jnp.arange(spec.W, dtype=jnp.int32)[None, :]
     do = mask & (n.infl_count < spec.W)
     sel = do[:, None] & (w == pos[:, None])
+    ends = jnp.where(sel, end[:, None] if end.ndim else end, _ends(spec, n))
     return n.replace(
-        infl_ends=jnp.where(sel, end[:, None] if end.ndim else end, n.infl_ends),
+        infl_ends=ends.reshape(-1),
         infl_count=n.infl_count + do.astype(jnp.int32),
     )
 
 
 def free_le(spec: Spec, n: NodeState, mask: jnp.ndarray, idx: jnp.ndarray) -> NodeState:
     """Inflights.FreeLE (inflights.go:95-122): pop the (sorted) prefix <= idx."""
-    freed = (_valid(spec, n) & (n.infl_ends <= idx)).sum(axis=-1).astype(jnp.int32)
+    freed = (
+        (_valid(spec, n) & (_ends(spec, n) <= idx)).sum(axis=-1).astype(jnp.int32)
+    )
     freed = jnp.where(mask, freed, 0)
     return n.replace(
         infl_start=(n.infl_start + freed) % spec.W,

@@ -3,9 +3,17 @@
 The reference accumulates outbound messages in ``r.msgs`` (raft/raft.go:264,
 appended by send() at raft.go:386-419) and the transport may drop messages
 ("Send MUST NOT block / drop is OK", server/etcdserver/raft.go:107-110;
-rafttest/network.go:106-108). Here the outbox is a dense ``[M, K]`` plane of
+rafttest/network.go:106-108). Here the outbox is a dense ``[K, M]`` plane of
 Msg slots plus a per-destination fill counter; emitting past K drops the
 message, which is legal by the same contract.
+
+Axis order matters on TPU: per-node leaves are [K, M(dest), ...] with the
+member axis LAST so that, after the fleet vmap appends the clusters axis,
+every materialized temp ends in (..., M, C) — a (5, big) minor pair that
+tiles to (8, 128) with <=1.6x padding. The previous [M, K] order left the
+tiny K/E axes minor-most and the TPU layout padded message temps 60-130x,
+OOMing fleet-scale programs (see the C=65536 compile report: 100-200MB
+temps for 1.6-3MB of data).
 """
 from __future__ import annotations
 
@@ -13,19 +21,35 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from etcd_tpu.types import Msg, NONE_ID, Spec, empty_msg
+from etcd_tpu.types import ENT_FIELDS as _ENT_FIELDS, Msg, NONE_ID, Spec, empty_msg
 
 
 class Outbox(struct.PyTreeNode):
-    msgs: Msg              # leaves [M, K, ...]
+    # msgs leaves are stored FLAT: [K*M(dest)] (ent fields [K*M*E]) —
+    # the outbox is a lax.scan carry in node_round, and a carry leaf whose
+    # minor logical dims are tiny (K=2, E=1) gets tile-padded up to 200x
+    # once batched to fleet shape (observed: three 2.5GB HLO temps for
+    # 13MB of data at C=65536). Rank-1 per-node leaves batch to
+    # [member, C, K*M*E], whose minor pair includes a medium axis.
+    # emit() views them as [K, M, (E)] via free reshapes.
+    msgs: Msg
     counts: jnp.ndarray    # i32[M]
+
+
+def _view(spec: Spec, name: str, x: jnp.ndarray) -> jnp.ndarray:
+    if name in _ENT_FIELDS:
+        return x.reshape(spec.K, spec.M, spec.E)
+    return x.reshape(spec.K, spec.M)
 
 
 def empty_outbox(spec: Spec) -> Outbox:
     m = empty_msg(spec)
-    msgs = jax.tree.map(
-        lambda x: jnp.broadcast_to(x, (spec.M, spec.K) + x.shape), m
-    )
+
+    def mk(name, x):
+        n = spec.K * spec.M * (spec.E if name in _ENT_FIELDS else 1)
+        return jnp.zeros((n,), x.dtype)
+
+    msgs = Msg(**{k: mk(k, getattr(m, k)) for k in Msg.__dataclass_fields__})
     return Outbox(msgs=msgs, counts=jnp.zeros((spec.M,), jnp.int32))
 
 
@@ -49,16 +73,18 @@ def emit(spec: Spec, ob: Outbox, to_mask: jnp.ndarray, m: Msg) -> Outbox:
     slot for every destination in `to_mask`; silently drop on overflow."""
     slot_idx = ob.counts                       # [M]
     can = to_mask & (slot_idx < spec.K)        # [M]
-    sel = can[:, None] & (
-        jnp.arange(spec.K, dtype=jnp.int32)[None, :] == slot_idx[:, None]
-    )  # [M, K]
+    sel = can[None, :] & (
+        jnp.arange(spec.K, dtype=jnp.int32)[:, None] == slot_idx[None, :]
+    )  # [K, M]
 
-    def upd(old, new):
+    def upd(name):
+        old = _view(spec, name, getattr(ob.msgs, name))
+        new = getattr(m, name)
         extra = old.ndim - 2
         s = sel.reshape(sel.shape + (1,) * extra)
-        return jnp.where(s, new[:, None], old)
+        return jnp.where(s, new[None], old).reshape(-1)
 
-    msgs = jax.tree.map(upd, ob.msgs, m)
+    msgs = Msg(**{k: upd(k) for k in Msg.__dataclass_fields__})
     return Outbox(msgs=msgs, counts=ob.counts + can.astype(jnp.int32))
 
 

@@ -66,12 +66,13 @@ class RangeCache:
     def invalidate(self, wkey: bytes, wend: bytes | None = None) -> None:
         with self._lock:
             dead = []
-            for (ckey, cend, _lim, _co) in self._data:
+            for entry in self._data:
+                ckey, cend = entry[0], entry[1]
                 if wend is None:
                     if _overlaps(ckey, cend, wkey):
-                        dead.append((ckey, cend, _lim, _co))
+                        dead.append(entry)
                 elif _overlaps(wkey, wend, ckey) or _overlaps(ckey, cend, wkey):
-                    dead.append((ckey, cend, _lim, _co))
+                    dead.append(entry)
             for k in dead:
                 del self._data[k]
 
@@ -86,7 +87,13 @@ class WatchCoalescer:
         self._next_sub = 1
 
     def create(self, create_request: dict) -> int:
-        rng = (create_request["key"], create_request.get("range_end"))
+        # coalesce only watches with identical replay semantics: a
+        # different start_revision/prev_kv needs its own upstream watcher
+        rng = (
+            create_request["key"], create_request.get("range_end"),
+            int(create_request.get("start_revision", 0) or 0),
+            bool(create_request.get("prev_kv")),
+        )
         with self._lock:
             b = self._bcasts.get(rng)
             if b is None:
@@ -153,6 +160,8 @@ class Proxy:
                 base64.b64decode(q["range_end"]) if q.get("range_end")
                 else None,
                 q.get("limit", 0), bool(q.get("count_only")),
+                int(q.get("revision", 0) or 0),  # historical reads are
+                # distinct cache entries (grpcproxy cache keys by Revision)
             )
             cached = self.cache.get(ck)
             if cached is not None:

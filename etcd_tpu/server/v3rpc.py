@@ -351,7 +351,11 @@ class V3Api:
         return {"header": {}, "result": _jsonable(res)}
 
     # -- election / lock (api/v3election, api/v3lock) ------------------------
-    def _session(self, lease: int) -> Session:
+    def _session(self, lease: int, required: bool = True) -> Session:
+        # a shared lease-0 session would collide every caller onto one
+        # ownership key and break mutual exclusion
+        if required and lease <= 0:
+            raise ServerError("a positive lease is required")
         return _BoundSession(Client(self.ec), lease)
 
     def election_campaign(self, q: dict) -> dict:
@@ -373,7 +377,7 @@ class V3Api:
         return {"header": {}}
 
     def election_leader(self, q: dict) -> dict:
-        e = Election(self._session(0), _unb64(q["name"]))
+        e = Election(self._session(0, required=False), _unb64(q["name"]))
         kv = e.leader()
         if kv is None:
             raise ServerError("election: no leader")

@@ -151,6 +151,13 @@ class Msg(struct.PyTreeNode):
     c_learners_next: jnp.ndarray # i32 packed mask (MsgSnap)
 
 
+# Msg fields carrying a per-entry [E] axis (everything else is scalar).
+# Shared by the flat message-tensor packing in ops/outbox.py,
+# models/engine.py and models/rawnode.py — one definition so a new
+# entry-shaped field can't silently mis-reshape in one of them.
+ENT_FIELDS = ("ent_term", "ent_data", "ent_type")
+
+
 def empty_msg(spec: Spec) -> Msg:
     z = jnp.int32(0)
     return Msg(

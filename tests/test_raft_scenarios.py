@@ -1,0 +1,215 @@
+"""raft_test.go scenario parity: dueling candidates, stale messages,
+leadership-transfer edge cases, lease-based reads, proposal-forwarding
+knobs, and stale-leader convergence — driven through the batched Cluster
+harness the way the reference drives its fake network
+(raft/raft_test.go:4633-4760).
+"""
+import numpy as np
+import pytest
+
+from etcd_tpu.harness.cluster import Cluster
+from etcd_tpu.types import (
+    CAMPAIGN_TRANSFER,
+    MSG_TIMEOUT_NOW,
+    NONE_ID,
+    ROLE_CANDIDATE,
+    ROLE_FOLLOWER,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import RaftConfig
+
+
+def elect(cl: Cluster, m: int = 0) -> int:
+    cl.campaign(m)
+    cl.stabilize()
+    lead = cl.leader()
+    assert lead == m
+    return lead
+
+
+# -- TestDuelingCandidates ---------------------------------------------------
+def test_dueling_candidates():
+    cl = Cluster(3)
+    cl.cut(0, 2)  # 0 and 2 can't talk; both campaign
+    cl.campaign(0)
+    cl.campaign(2)
+    cl.stabilize()
+    roles = cl.roles()
+    # node 1 is the tiebreaker: exactly one of {0,2} won its quorum
+    assert (roles == ROLE_LEADER).sum() == 1
+    winner = cl.leader()
+    cl.recover()
+    cl.propose(winner, 7)
+    # ticked stabilize: the paused probe toward the cut-off node resumes
+    # on the next heartbeat exchange (IsPaused, tracker/progress.go:201)
+    cl.stabilize(tick=True)
+    cl.stabilize(tick=True)
+    cl.stabilize()
+    assert min(cl.commits()) == max(cl.commits()) >= 1
+
+
+# -- TestOldMessages ---------------------------------------------------------
+def test_old_messages_ignored():
+    from etcd_tpu.types import MSG_APP
+
+    cl = Cluster(3)
+    elect(cl, 0)
+    # term moves on: node 1 takes over
+    cl.campaign(1)
+    cl.stabilize()
+    assert cl.leader() == 1
+    t_new = cl.get("term", 1)
+    commit_before = cl.commits().copy()
+    # inject a stale MsgApp at the old term into node 2
+    cl.inject(to=2, frm=0, type=MSG_APP, term=t_new - 1, index=0,
+              log_term=0, commit=5)
+    cl.stabilize()
+    # the stale leader's commit hint must not move node 2
+    assert cl.get("term", 2) == t_new
+    assert (cl.commits() >= commit_before).all()
+    assert cl.get("commit", 2) == commit_before[2]
+
+
+# -- leadership transfer (raft.go:1339-1369) ---------------------------------
+def test_transfer_to_up_to_date_follower():
+    cl = Cluster(3)
+    elect(cl, 0)
+    cl.propose(0, 5)
+    cl.stabilize()
+    cl.inject(to=0, frm=1, type=10, term=cl.get("term", 0))  # MsgTransferLeader
+    cl.stabilize()
+    assert cl.leader() == 1
+    assert cl.get("role", 0) == ROLE_FOLLOWER
+
+
+def test_transfer_to_lagging_follower_waits_for_catchup():
+    cl = Cluster(3)
+    elect(cl, 0)
+    cl.isolate(2)
+    for d in (5, 6, 7):
+        cl.propose(0, d)
+        cl.stabilize()
+    assert cl.get("match", 0)[2] < cl.get("last_index", 0)
+    cl.recover()
+    # transfer request while 2 is behind: leader first catches it up, then
+    # sends MsgTimeoutNow once match == lastIndex
+    cl.inject(to=0, frm=2, type=10, term=cl.get("term", 0))
+    cl.stabilize()
+    assert cl.leader() == 2
+    assert cl.get("last_index", 2) >= 4
+
+
+def test_transfer_aborts_on_election_timeout():
+    cl = Cluster(3)
+    elect(cl, 0)
+    cl.isolate(2)
+    cl.inject(to=0, frm=2, type=10, term=cl.get("term", 0))
+    cl.step()
+    assert cl.get("lead_transferee", 0) == 2
+    # the transfer target never catches up; a full election timeout at the
+    # leader abandons the transfer (raft.go:668-671)
+    for _ in range(cl.cfg.election_tick + 1):
+        cl.step(tick=True)
+    assert cl.get("lead_transferee", 0) == NONE_ID
+    assert cl.leader() == 0 or cl.get("role", 0) == ROLE_LEADER
+
+
+def test_transfer_to_self_and_learner_ignored():
+    cl = Cluster(
+        4, voters=[True, True, True, False],
+        learners=[False, False, False, True], spec=Spec(M=4),
+    )
+    elect(cl, 0)
+    t = cl.get("term", 0)
+    cl.inject(to=0, frm=0, type=10, term=t)  # self-transfer: no-op
+    cl.stabilize()
+    assert cl.leader() == 0
+    cl.inject(to=0, frm=3, type=10, term=t)  # learner: ignored
+    cl.stabilize()
+    assert cl.leader() == 0
+    assert cl.get("lead_transferee", 0) == NONE_ID
+
+
+def test_timeout_now_forces_election_past_lease():
+    """MsgTimeoutNow campaigns with CAMPAIGN_TRANSFER, overriding the
+    check-quorum leader lease that normally rejects the vote
+    (raft.go:855-881 force flag)."""
+    cfg = RaftConfig(pre_vote=True, check_quorum=True)
+    cl = Cluster(3, cfg=cfg)
+    elect(cl, 0)
+    t = cl.get("term", 0)
+    cl.inject(to=1, frm=0, type=MSG_TIMEOUT_NOW, term=t)
+    cl.stabilize()
+    assert cl.leader() == 1
+    assert cl.get("term", 1) == t + 1
+
+
+# -- proposal forwarding knobs ----------------------------------------------
+def test_disable_proposal_forwarding():
+    cfg = RaftConfig(disable_proposal_forwarding=True)
+    cl = Cluster(3, cfg=cfg)
+    elect(cl, 0)
+    last = cl.get("last_index", 0)
+    cl.propose(1, 9)  # follower proposal: dropped, not forwarded
+    cl.stabilize()
+    assert cl.get("last_index", 0) == last
+
+
+# -- ReadOnlyLeaseBased (raft.go:53-58, read_only.go) ------------------------
+def test_read_index_lease_based():
+    cfg = RaftConfig(check_quorum=True, read_only_lease_based=True)
+    cl = Cluster(3, cfg=cfg)
+    elect(cl, 0)
+    cl.propose(0, 5)
+    cl.stabilize()
+    commit = cl.get("commit", 0)
+    ctx = cl.read_index(0)
+    cl.step()  # lease-based: answered locally, no heartbeat round needed
+    rs_count = cl.get("rs_count", 0)
+    assert rs_count >= 1
+    ctxs = cl.get("rs_ctx", 0)
+    idxs = cl.get("rs_index", 0)
+    assert ctxs[0] == ctx and idxs[0] == commit
+
+
+# -- candidate concedes to a live leader -------------------------------------
+def test_candidate_steps_down_on_leader_heartbeat():
+    from etcd_tpu.types import MSG_HEARTBEAT
+
+    cl = Cluster(3)
+    elect(cl, 0)
+    t = cl.get("term", 0)
+    # drive node 2 into candidacy at t+1 while partitioned
+    cl.isolate(2)
+    cl.campaign(2)
+    cl.stabilize()
+    assert cl.get("role", 2) == ROLE_CANDIDATE
+    cl.recover()
+    # a heartbeat from the (re-elected at t+?) leader at the candidate's
+    # term makes it concede (raft.go:1390-1398)
+    cl.inject(to=2, frm=0, type=MSG_HEARTBEAT, term=cl.get("term", 2))
+    cl.stabilize()
+    assert cl.get("role", 2) == ROLE_FOLLOWER
+
+
+# -- stale minority leader converges after heal ------------------------------
+def test_stale_leader_steps_down_after_heal():
+    cl = Cluster(5, spec=Spec(M=5))
+    elect(cl, 0)
+    # leader 0 keeps only follower 1; nodes 2,3,4 elect a new leader
+    cl.partition([[0, 1], [2, 3, 4]])
+    cl.campaign(2)
+    cl.stabilize()
+    leaders = set(cl.leaders())
+    assert 2 in leaders  # majority side elected
+    assert cl.get("term", 2) > cl.get("term", 0) or 0 not in leaders
+    cl.recover()
+    cl.propose(2, 9)
+    # heartbeats carry the new term to the stale minority leader
+    cl.stabilize(tick=True)
+    cl.stabilize(tick=True)
+    cl.stabilize()
+    assert cl.leaders() == [2]  # the stale leader stepped down
+    assert cl.get("role", 0) == ROLE_FOLLOWER
+    assert min(cl.commits()) == max(cl.commits())
