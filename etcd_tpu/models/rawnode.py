@@ -291,10 +291,10 @@ def outbox_to_host(spec: Spec, ob: Outbox) -> list[HostMsg]:
                 )
                 snap = Snapshot(
                     meta=SnapshotMeta(
-                        index=int(f["index"][to, k]),
-                        term=int(f["log_term"][to, k]),
+                        index=int(f["index"][k, to]),
+                        term=int(f["log_term"][k, to]),
                         conf_state=cs,
-                        app_hash=int(f["commit"][to, k]),
+                        app_hash=int(f["commit"][k, to]),
                     )
                 )
             out.append(
