@@ -1,0 +1,176 @@
+"""Benchmark CLI: the tools/benchmark analog.
+
+The reference ships a cobra load generator (tools/benchmark/cmd: put,
+range, txn-put, txn-mixed, lease, watch, watch-latency, ...) reporting
+latency histograms and throughput via pkg/report. This drives the same
+workloads over the v3 JSON/HTTP wire against any endpoint (a live
+etcd_tpu.etcdmain process or the reference's gateway) and prints a
+pkg/report-style summary.
+
+Usage:
+    python -m etcd_tpu.benchmark --endpoint http://127.0.0.1:2379 \
+        put --total 1000 --key-size 8 --val-size 32
+    python -m etcd_tpu.benchmark range --total 500 --serializable
+    python -m etcd_tpu.benchmark txn-put --total 200
+    python -m etcd_tpu.benchmark watch-latency --total 100
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import math
+import os
+import sys
+import time
+import urllib.request
+
+
+def b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+class Wire:
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint.rstrip("/")
+
+    def call(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.endpoint + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())
+
+
+class Report:
+    """pkg/report analog: latency summary + histogram."""
+
+    def __init__(self):
+        self.lat: list[float] = []
+
+    def add(self, seconds: float) -> None:
+        self.lat.append(seconds)
+
+    def render(self, total_s: float) -> str:
+        n = len(self.lat)
+        if not n:
+            return "no samples"
+        lat = sorted(self.lat)
+        pct = lambda p: lat[min(n - 1, int(math.ceil(p * n)) - 1)] * 1000
+        lines = [
+            "",
+            "Summary:",
+            f"  Total:\t{total_s:.4f} secs.",
+            f"  Slowest:\t{lat[-1] * 1000:.4f} ms.",
+            f"  Fastest:\t{lat[0] * 1000:.4f} ms.",
+            f"  Average:\t{sum(lat) / n * 1000:.4f} ms.",
+            f"  Requests/sec:\t{n / total_s:.4f}",
+            "",
+            "Latency distribution:",
+        ]
+        for p in (0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99):
+            lines.append(f"  {int(p * 100)}% in {pct(p):.4f} ms.")
+        # coarse histogram (pkg/report prints one too)
+        lo, hi = lat[0], lat[-1]
+        buckets = 8
+        width = (hi - lo) / buckets or 1e-9
+        counts = [0] * buckets
+        for v in lat:
+            counts[min(buckets - 1, int((v - lo) / width))] += 1
+        lines.append("")
+        lines.append("Response time histogram:")
+        peak = max(counts)
+        for i, c in enumerate(counts):
+            bar = "|" + "-" * int(40 * c / peak) if peak else "|"
+            lines.append(f"  {(lo + i * width) * 1000:8.4f} ms [{c}]\t{bar}")
+        return "\n".join(lines)
+
+
+def _timed(rep: Report, fn) -> None:
+    t0 = time.perf_counter()
+    fn()
+    rep.add(time.perf_counter() - t0)
+
+
+def run_put(w: Wire, args) -> Report:
+    rep = Report()
+    for i in range(args.total):
+        key = os.urandom(max(args.key_size // 2, 1)).hex().encode()
+        val = b"v" * args.val_size
+        _timed(rep, lambda: w.call(
+            "/v3/kv/put", {"key": b64(b"bench/" + key), "value": b64(val)}
+        ))
+    return rep
+
+
+def run_range(w: Wire, args) -> Report:
+    w.call("/v3/kv/put", {"key": b64(b"bench/r"), "value": b64(b"x")})
+    rep = Report()
+    body = {"key": b64(b"bench/r")}
+    if args.serializable:
+        body["serializable"] = True
+    for _ in range(args.total):
+        _timed(rep, lambda: w.call("/v3/kv/range", dict(body)))
+    return rep
+
+
+def run_txn_put(w: Wire, args) -> Report:
+    rep = Report()
+    for i in range(args.total):
+        key = b64(b"bench/t%d" % (i % 64))
+        body = {
+            "compare": [],
+            "success": [{"request_put": {"key": key,
+                                         "value": b64(b"v" * args.val_size)}}],
+            "failure": [],
+        }
+        _timed(rep, lambda: w.call("/v3/kv/txn", body))
+    return rep
+
+
+def run_watch_latency(w: Wire, args) -> Report:
+    """Time from put to the event arriving at a watcher
+    (tools/benchmark/cmd/watch_latency.go)."""
+    res = w.call("/v3/watch", {"create_request": {"key": b64(b"bench/w")}})
+    wid = res["watch_id"]
+    rep = Report()
+    for i in range(args.total):
+        t0 = time.perf_counter()
+        w.call("/v3/kv/put", {"key": b64(b"bench/w"),
+                              "value": b64(b"%d" % i)})
+        while True:
+            evs = w.call("/v3/watch",
+                         {"poll_request": {"watch_id": wid}})["events"]
+            if evs:
+                break
+        rep.add(time.perf_counter() - t0)
+    w.call("/v3/watch", {"cancel_request": {"watch_id": wid}})
+    return rep
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="benchmark-tpu")
+    p.add_argument("--endpoint", default="http://127.0.0.1:2379")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("put", "range", "txn-put", "watch-latency"):
+        s = sub.add_parser(name)
+        s.add_argument("--total", type=int, default=100)
+        s.add_argument("--key-size", type=int, default=8)
+        s.add_argument("--val-size", type=int, default=32)
+        if name == "range":
+            s.add_argument("--serializable", action="store_true")
+    args = p.parse_args(argv)
+    w = Wire(args.endpoint)
+    runner = {
+        "put": run_put, "range": run_range, "txn-put": run_txn_put,
+        "watch-latency": run_watch_latency,
+    }[args.cmd]
+    t0 = time.perf_counter()
+    rep = runner(w, args)
+    print(rep.render(time.perf_counter() - t0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

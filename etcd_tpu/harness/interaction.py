@@ -411,8 +411,6 @@ class InteractionEnv:
                     f"{MSG_NAMES[m.type]} request to {self.r(m.to)} at term "
                     f"{int(n.term)}",
                 )
-        if role == ROLE_LEADER and before.role != ROLE_LEADER:
-            pass  # became-leader line already emitted by _emit_transitions
 
     def propose(self, idx: int, data: bytes | str) -> None:
         word = self.payloads.intern(data)

@@ -53,7 +53,7 @@ class FleetMetrics(struct.PyTreeNode):
     leader_losses: jnp.ndarray   # nodes that stopped being leader
     commits: jnp.ndarray         # sum of per-node commit advances
     applies: jnp.ndarray         # sum of per-node applied advances
-    msgs_sent: jnp.ndarray       # outbox slots emitted (pre fault-mask)
+    msgs_delivered: jnp.ndarray  # slots surviving the fault mask
     msgs_dropped: jnp.ndarray    # emitted slots killed by the keep-mask
     lag_hist: jnp.ndarray        # [len(LAG_BUCKETS)+1] cumulative lag counts
 
@@ -62,7 +62,7 @@ def zero_metrics() -> FleetMetrics:
     z = jnp.int64(0) if jax.config.jax_enable_x64 else jnp.int32(0)
     return FleetMetrics(
         rounds=z, elections_won=z, leader_losses=z, commits=z, applies=z,
-        msgs_sent=z, msgs_dropped=z,
+        msgs_delivered=z, msgs_dropped=z,
         lag_hist=jnp.zeros((len(LAG_BUCKETS) + 1,), z.dtype),
     )
 
@@ -107,7 +107,7 @@ def build_metered_round(cfg: RaftConfig, spec: Spec):
             + (state.commit - commit0).sum().astype(dt),
             applies=metrics.applies
             + (state.applied - applied0).sum().astype(dt),
-            msgs_sent=metrics.msgs_sent + delivered,
+            msgs_delivered=metrics.msgs_delivered + delivered,
             msgs_dropped=metrics.msgs_dropped + dropped.astype(dt),
             lag_hist=metrics.lag_hist + hist,
         )
@@ -121,7 +121,7 @@ def metrics_report(metrics: FleetMetrics, elapsed_s: float | None = None,
                    n_members: int | None = None) -> dict:
     """One host transfer -> a plain dict (the /metrics endpoint analog)."""
     m = jax.device_get(metrics)
-    if int(m.msgs_sent) < 0 or int(m.commits) < 0 or int(m.applies) < 0:
+    if int(m.msgs_delivered) < 0 or int(m.commits) < 0 or int(m.applies) < 0:
         raise OverflowError(
             "FleetMetrics counter wrapped (i32); reset metrics per window "
             "with zero_metrics()"
@@ -132,7 +132,7 @@ def metrics_report(metrics: FleetMetrics, elapsed_s: float | None = None,
         "leader_losses": int(m.leader_losses),
         "commits_total": int(m.commits),
         "applies_total": int(m.applies),
-        "msgs_delivered": int(m.msgs_sent),
+        "msgs_delivered": int(m.msgs_delivered),
         "msgs_dropped": int(m.msgs_dropped),
         "commit_apply_lag_hist": {
             **{f"le_{b}": int(v) for b, v in zip(LAG_BUCKETS, m.lag_hist)},

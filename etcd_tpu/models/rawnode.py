@@ -621,10 +621,7 @@ class RawNode:
             raise ValueError(
                 f"cannot compact beyond applied index {int(n.applied)}"
             )
-        term = (
-            int(n.snap_term) if index == int(n.snap_index)
-            else self.ring_entries(index, index + 1)[0].term
-        )
+        term = self.ring_entries(index, index + 1)[0].term
         # the applied hash at `index` equals the current hash only when
         # applied == index; otherwise the snapshot hash stays at the last
         # known point (the chain cannot be rewound)

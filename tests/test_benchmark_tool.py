@@ -1,0 +1,42 @@
+"""Benchmark-tool tests: the tools/benchmark analog drives a live
+embedded server over the wire and reports pkg/report-style summaries."""
+import io
+import sys
+
+import pytest
+
+from etcd_tpu import benchmark
+from etcd_tpu.embed import Config, start_etcd
+
+
+@pytest.fixture(scope="module")
+def etcd():
+    e = start_etcd(Config(cluster_size=3, auto_tick=False))
+    yield e
+    e.close()
+
+
+def run(etcd, *argv) -> str:
+    out = io.StringIO()
+    old = sys.stdout
+    sys.stdout = out
+    try:
+        rc = benchmark.main(["--endpoint", etcd.client_url, *argv])
+    finally:
+        sys.stdout = old
+    assert rc == 0
+    return out.getvalue()
+
+
+def test_benchmark_put_and_range(etcd):
+    out = run(etcd, "put", "--total", "20", "--val-size", "16")
+    assert "Requests/sec:" in out and "99% in" in out
+    out = run(etcd, "range", "--total", "20", "--serializable")
+    assert "Latency distribution:" in out
+
+
+def test_benchmark_txn_and_watch_latency(etcd):
+    out = run(etcd, "txn-put", "--total", "10")
+    assert "Summary:" in out
+    out = run(etcd, "watch-latency", "--total", "5")
+    assert "Requests/sec:" in out
