@@ -7,9 +7,10 @@
   applied-state equality checker from tests/functional.
 """
 import numpy as np
+import pytest
 
 from etcd_tpu.harness.cluster import Cluster
-from etcd_tpu.types import NONE_ID, ROLE_LEADER, Spec
+from etcd_tpu.types import MSG_VOTE_RESP, NONE_ID, ROLE_LEADER, Spec
 
 
 def applied_consistent(cl, c: int = 0):
@@ -157,6 +158,65 @@ def test_tick_based_election_fires():
     assert cl.leader() != NONE_ID
     # exactly one leader at the max term
     assert len(cl.leaders()) == 1
+
+
+LEADER_TERMS = [1, 1, 1, 4, 4, 5, 5, 6, 6, 6]  # terms at indexes 1..10
+
+# The six follower logs of Raft paper figure 7 (terms at indexes 1..n),
+# exactly the table in TestLeaderSyncFollowerLog (raft_paper_test.go:695-748):
+# (a) missing the last entry, (b) truncated at 4, (c) one extra term-6 entry,
+# (d) two extra term-7 entries, (e) divergent term-4 tail, (f) divergent
+# term-2/3 tail.
+FIG7_FOLLOWER_TERMS = [
+    [1, 1, 1, 4, 4, 5, 5, 6, 6],
+    [1, 1, 1, 4],
+    [1, 1, 1, 4, 4, 5, 5, 6, 6, 6, 6],
+    [1, 1, 1, 4, 4, 5, 5, 6, 6, 6, 7, 7],
+    [1, 1, 1, 4, 4, 4, 4],
+    [1, 1, 1, 2, 2, 2, 3, 3, 3, 3, 3],
+]
+
+
+def _load_log(cl, m, terms, term, commit=0):
+    """set_node analog of newTestRaft(storage.Append(ents)) + loadState
+    (raft_paper_test.go:749-756): entry data = 100*idx + entry term so a
+    kept-but-should-be-overwritten entry is detectable."""
+    L = cl.spec.L
+    lt = np.zeros(L, np.int32)
+    ld = np.zeros(L, np.int32)
+    for i, t in enumerate(terms, start=1):
+        lt[(i - 1) % L] = t
+        ld[(i - 1) % L] = 100 * i + t
+    cl.set_node(m, term=term, commit=commit, last_index=len(terms),
+                log_term=lt, log_data=ld)
+
+
+@pytest.mark.parametrize("case", range(len(FIG7_FOLLOWER_TERMS)))
+def test_leader_sync_follower_log(case):
+    """TestLeaderSyncFollowerLog (raft_paper_test.go:695-768, §5.3 fig.7):
+    a new leader brings each of the six divergent follower logs of figure 7
+    into consistency with its own. Node 2 plays the nopStepper: isolated,
+    with its decisive vote injected by hand (raft_paper_test.go:762-764)."""
+    cl = Cluster(n_members=3)
+    term = 8
+    _load_log(cl, 0, LEADER_TERMS, term, commit=len(LEADER_TERMS))
+    _load_log(cl, 1, FIG7_FOLLOWER_TERMS[case], term - 1)
+    cl.isolate(2)  # nopStepper: receives nothing, says nothing
+    cl.campaign(0)
+    cl.step()  # candidate at term 9, MsgVotes out
+    cl.inject(to=0, frm=2, type=MSG_VOTE_RESP, term=term + 1, reject=False)
+    cl.stabilize()
+    assert cl.get("role", 0) == ROLE_LEADER
+    cl.propose(0, 999)
+    cl.stabilize()
+    lead_log = cl.log_entries(0)
+    # leader log = original 10 entries + empty entry at term 9 + proposal
+    assert lead_log[: len(LEADER_TERMS)] == [
+        (t, 100 * i + t) for i, t in enumerate(LEADER_TERMS, start=1)
+    ]
+    assert [t for t, _ in lead_log[len(LEADER_TERMS):]] == [9, 9]
+    assert cl.log_entries(1) == lead_log, f"fig.7 case {case}"
+    assert cl.get("commit", 1) == cl.get("commit", 0) == len(lead_log)
 
 
 def test_batched_divergence():
