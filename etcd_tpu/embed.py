@@ -40,6 +40,11 @@ class Config:
     pre_vote: bool = True               # --pre-vote
     check_quorum: bool = True
     auto_tick: bool = True              # background ticker on/off
+    # --auth-token (embed/config.go AuthToken): "simple" or
+    # "jwt[,sign-method=HS256][,ttl=SECONDS]"; jwt needs auth_jwt_key
+    # (the priv-key= file contents of the reference flag)
+    auth_token: str = "simple"
+    auth_jwt_key: bytes | None = None
 
     def validate(self) -> None:
         if self.cluster_size < 1:
@@ -50,6 +55,10 @@ class Config:
             raise ValueError(
                 f"unknown auto-compaction mode {self.auto_compaction_mode}"
             )
+        if self.auth_token.split(",")[0] not in ("simple", "jwt"):
+            raise ValueError(f"unknown auth token provider {self.auth_token}")
+        if self.auth_token.split(",")[0] == "jwt" and not self.auth_jwt_key:
+            raise ValueError("auth_token=jwt requires auth_jwt_key")
 
 
 class Etcd:
@@ -72,6 +81,8 @@ class Etcd:
             cluster=Cluster(n_members=cfg.cluster_size, cfg=raft_cfg),
             quota_bytes=cfg.quota_backend_bytes,
             data_dir=cfg.data_dir,
+            auth_token=cfg.auth_token,
+            auth_jwt_key=cfg.auth_jwt_key,
         )
         self.server.ensure_leader()
         self.compactor = Compactor(
@@ -117,6 +128,8 @@ class Etcd:
                 self.compactor.tick()
 
     def close(self) -> None:
+        from etcd_tpu.utils.logging import get_logger
+
         self._stop.set()
         if self._ticker:
             self._ticker.join(timeout=2)
@@ -124,8 +137,16 @@ class Etcd:
         for ms in self.server.members:
             if ms.backend is not None:
                 ms.backend.close()
+        get_logger().info("etcd %r stopped", self.config.name)
 
 
 def start_etcd(cfg: Config) -> Etcd:
     """embed.StartEtcd (server/embed/etcd.go:104)."""
-    return Etcd(cfg)
+    from etcd_tpu.utils.logging import get_logger
+
+    e = Etcd(cfg)
+    get_logger().info(
+        "etcd %r serving %d members at %s", cfg.name, cfg.cluster_size,
+        e.client_url,
+    )
+    return e

@@ -5,14 +5,21 @@ bcrypt is an external dep in the reference, auth/store.go:90 iface area),
 roles grant {READ, WRITE, READWRITE} over key ranges (interval perms cached
 per user, auth/range_perm_cache.go), and every mutation bumps an
 *auth revision* so tokens minted under an older ACL are rejected
-(store.go's authRevision / ErrAuthOldRevision). Token provider is the
-reference's `simple` type: opaque TTL'd random tokens (jwt is config-gated
-there; out of scope until the config system grows a flag for it).
+(store.go's authRevision / ErrAuthOldRevision). Two token providers, as in
+the reference (auth/store.go NewTokenProvider): `simple` — opaque TTL'd
+random tokens held in node-local memory — and `jwt` — stateless HS256
+tokens carrying {username, revision, exp} claims (auth/jwt.go:28,117;
+HMAC instead of the reference's RSA/ECDSA default because stdlib has no
+asymmetric crypto, matching jwt.go's symmetric-key branch where the same
+key signs and verifies).
 """
 from __future__ import annotations
 
+import base64
 import dataclasses
 import hashlib
+import hmac
+import json
 import os
 import secrets
 
@@ -58,6 +65,70 @@ class ErrAuthOldRevision(AuthError):
 
 
 READ, WRITE, READWRITE = 0, 1, 2
+
+
+def _b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def _b64url_dec(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+class JWTTokenProvider:
+    """Stateless HS256 JWT provider (auth/jwt.go:28 tokenJWT).
+
+    assign() mints {username, revision, exp} claims (jwt.go:117-127);
+    info() verifies the signature + algorithm and rejects expired tokens.
+    Like the reference, user deletion does NOT invalidate outstanding jwt
+    tokens (tokenJWT.invalidateUser is a no-op, jwt.go:38) — revocation
+    happens through the auth-revision check at permission time.
+    """
+
+    def __init__(self, key: bytes, ttl: int = 300, sign_method: str = "HS256"):
+        if sign_method != "HS256":
+            raise AuthError(f"unsupported jwt sign method {sign_method!r} "
+                            "(stdlib build supports HS256 only)")
+        if not key:
+            raise AuthError("jwt token provider requires a signing key")
+        self.key = key
+        self.ttl = ttl
+        self.sign_method = sign_method
+
+    def _sign(self, signing_input: bytes) -> bytes:
+        return hmac.new(self.key, signing_input, hashlib.sha256).digest()
+
+    def assign(self, username: str, revision: int, now: int) -> str:
+        header = _b64url(json.dumps(
+            {"alg": self.sign_method, "typ": "JWT"},
+            separators=(",", ":"), sort_keys=True).encode())
+        claims = _b64url(json.dumps(
+            {"username": username, "revision": revision,
+             "exp": now + self.ttl},
+            separators=(",", ":"), sort_keys=True).encode())
+        signing_input = f"{header}.{claims}".encode()
+        return f"{header}.{claims}.{_b64url(self._sign(signing_input))}"
+
+    def info(self, token: str, now: int) -> tuple[str, int]:
+        try:
+            header_s, claims_s, sig_s = token.split(".")
+            header = json.loads(_b64url_dec(header_s))
+            if header.get("alg") != self.sign_method:
+                raise ErrInvalidAuthToken("invalid signing method")
+            want = self._sign(f"{header_s}.{claims_s}".encode())
+            if not hmac.compare_digest(want, _b64url_dec(sig_s)):
+                raise ErrInvalidAuthToken("bad signature")
+            claims = json.loads(_b64url_dec(claims_s))
+            username = claims["username"]
+            revision = int(claims["revision"])
+            exp = int(claims["exp"])
+        except ErrInvalidAuthToken:
+            raise
+        except Exception:
+            raise ErrInvalidAuthToken("malformed jwt token")
+        if exp <= now:
+            raise ErrInvalidAuthToken("expired jwt token")
+        return username, revision
 
 
 @dataclasses.dataclass
@@ -106,14 +177,35 @@ class AuthStore:
     ROOT_ROLE = "root"
     TOKEN_TTL = 300  # simpleTokenTTL (auth/simple_token.go), in ticks here
 
-    def __init__(self):
+    def __init__(self, token: str = "simple", jwt_key: bytes | None = None):
+        """`token` mirrors the reference's --auth-token flag
+        (auth/store.go NewTokenProvider): "simple", or
+        "jwt[,sign-method=HS256][,ttl=SECONDS]" with the signing key
+        supplied via `jwt_key` (the priv-key= file of the reference)."""
         self.enabled = False
         self.revision = 1
         self.users: dict[str, User] = {}
         self.roles: dict[str, Role] = {}
-        # token -> (username, auth_revision, expiry_tick)
+        # token -> (username, auth_revision, expiry_tick)  [simple provider]
         self.tokens: dict[str, tuple[str, int, int]] = {}
         self.now = 0
+        parts = token.split(",")
+        self.token_type = parts[0]
+        if self.token_type == "jwt":
+            try:
+                opts = dict(p.split("=", 1) for p in parts[1:] if p)
+                ttl = int(opts.get("ttl", self.TOKEN_TTL))
+            except ValueError as e:
+                raise AuthError(f"invalid jwt token options {token!r}: {e}")
+            self.jwt = JWTTokenProvider(
+                key=jwt_key or b"",
+                ttl=ttl,
+                sign_method=opts.get("sign-method", "HS256"),
+            )
+        elif self.token_type == "simple":
+            self.jwt = None
+        else:
+            raise AuthError(f"unknown token provider {self.token_type!r}")
 
     def tick(self, n: int = 1) -> None:
         self.now += n
@@ -280,12 +372,16 @@ class AuthStore:
             raise ErrAuthFailed()
         if not u.no_password and _hash(password, u.salt) != u.pw_hash:
             raise ErrAuthFailed()
+        if self.jwt is not None:
+            return self.jwt.assign(name, self.revision, self.now)
         token = f"{name}.{secrets.token_hex(16)}"
         self.tokens[token] = (name, self.revision, self.now + self.TOKEN_TTL)
         return token
 
     def auth_info(self, token: str) -> tuple[str, int]:
         """(username, revision) for a live token."""
+        if self.jwt is not None:
+            return self.jwt.info(token, self.now)
         v = self.tokens.get(token)
         if v is None:
             raise ErrInvalidAuthToken()
@@ -327,5 +423,8 @@ class AuthStore:
         name, rev = self.auth_info(token)
         if rev < self.revision:
             raise ErrAuthOldRevision()
-        if self.ROOT_ROLE not in self.users[name].roles:
+        u = self.users.get(name)
+        if u is None:
+            raise ErrUserNotFound(name)
+        if self.ROOT_ROLE not in u.roles:
             raise ErrPermissionDenied(name)
