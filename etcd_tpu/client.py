@@ -144,10 +144,17 @@ class Client:
 
     # -- watch ---------------------------------------------------------------
     def watch(self, key: bytes, range_end: bytes | None = None,
-              start_rev: int = 0, prev_kv: bool = False, member: int | None = None):
+              start_rev: int = 0, prev_kv: bool = False,
+              member: int | None = None, filters: tuple = (),
+              progress_notify: bool = False, fragment: bool = False):
+        """clientv3 WatchCreateRequest options: `filters` drops event types
+        ("put"/"delete" — WithFilterPut/WithFilterDelete), `progress_notify`
+        = WithProgressNotify, `fragment` = WithFragment."""
         m = member if member is not None else self.ec.ensure_leader()
         w = self.ec.watch(
-            m, self._key(key), self._range_end(key, range_end), start_rev, prev_kv
+            m, self._key(key), self._range_end(key, range_end), start_rev,
+            prev_kv, fragment=fragment, progress_notify=progress_notify,
+            filters=filters,
         )
         return _WatchHandle(self, m, w.id)
 
@@ -174,6 +181,11 @@ class _WatchHandle:
     client: Client
     member: int
     watch_id: int
+
+    def request_progress(self) -> int | None:
+        """clientv3 Watcher.RequestProgress: current revision once this
+        watcher is fully synced, else None."""
+        return self.client.ec.watch_progress(self.member, self.watch_id)
 
     def events(self):
         evs = self.client.ec.watch_events(self.member, self.watch_id)

@@ -6,7 +6,8 @@ Commands here work on the backend files etcd_tpu writes
 (<data-dir>/member<N>.db) and the snapshot blobs etcdctl saves.
 
 Usage:
-    python -m etcd_tpu.etcdutl snapshot status snap.json
+    python -m etcd_tpu.etcdutl snapshot status snap.db
+    python -m etcd_tpu.etcdutl snapshot restore snap.db --data-dir D [--members 3]
     python -m etcd_tpu.etcdutl hashkv --data-dir D --member 0
     python -m etcd_tpu.etcdutl defrag --data-dir D
     python -m etcd_tpu.etcdutl status --data-dir D
@@ -17,6 +18,7 @@ import argparse
 import glob
 import json
 import os
+import pickle
 import sys
 
 
@@ -38,9 +40,29 @@ def _load(path: str):
     return be, meta, store
 
 
+class _DataOnlyUnpickler(pickle.Unpickler):
+    """Snapshot files travel between machines, so the loader must not be a
+    code-execution vector: member snapshots are pure data (dict/list/tuple/
+    bytes/str/int/bool/None — see kvserver.member_snapshot), and any GLOBAL
+    opcode in the stream is rejected outright."""
+
+    def find_class(self, module, name):
+        raise pickle.UnpicklingError(
+            f"snapshot file contains non-data object {module}.{name}; "
+            "refusing to load"
+        )
+
+
+def load_snapshot(path: str) -> dict:
+    """Read a snapshot file written by `etcdctl snapshot save` (the pickled
+    member snapshot the gateway streams, server/v3rpc.py
+    maintenance_snapshot)."""
+    with open(path, "rb") as f:
+        return _DataOnlyUnpickler(f).load()
+
+
 def cmd_snapshot_status(args) -> int:
-    with open(args.path, "rb") as f:
-        snap = json.load(f)
+    snap = load_snapshot(args.path)
     kv = snap.get("kv", {})
     print(json.dumps({
         "applied_index": snap.get("applied_index"),
@@ -48,6 +70,48 @@ def cmd_snapshot_status(args) -> int:
         "compact_revision": kv.get("compact_rev"),
         "total_key_revisions": len(kv.get("revs", [])),
         "alarms": snap.get("alarms", []),
+    }))
+    return 0
+
+
+def restore_snapshot(path: str, data_dir: str, members: int = 3) -> int:
+    """etcdutl snapshot restore (etcdutl/etcdutl/snapshot_command.go:81,122):
+    rewrite a fresh data dir whose every member backend holds the
+    snapshot's applied state at a uniform consistent index. Returns the
+    restored consistent index. The restored cluster boots via
+    EtcdCluster.boot_from_disk (the fresh-WAL-with-snapshot-marker boot of
+    the reference's restore)."""
+    from etcd_tpu.server.mvcc import MVCCStore
+    from etcd_tpu.storage import schema
+    from etcd_tpu.storage.backend import Backend
+
+    snap = load_snapshot(path)
+    idx = int(snap["applied_index"])
+    store = MVCCStore.from_snapshot(snap["kv"])
+    os.makedirs(data_dir, exist_ok=True)
+    for m in range(members):
+        be = Backend(os.path.join(data_dir, f"member{m}.db"), fresh=True)
+        schema.persist_mvcc_delta(be, store, 0)
+        schema.save_applied_meta(
+            be,
+            index=idx,
+            term=int(snap.get("term", 1)) or 1,
+            store=store,
+            lease_snap=snap.get("lease"),
+            auth_snap=snap.get("auth"),
+            alarms=snap.get("alarms", []),
+        )
+        be.commit()
+        be.close()
+    return idx
+
+
+def cmd_snapshot_restore(args) -> int:
+    idx = restore_snapshot(args.path, args.data_dir, args.members)
+    print(json.dumps({
+        "restored": args.data_dir,
+        "members": args.members,
+        "consistent_index": idx,
     }))
     return 0
 
@@ -102,6 +166,10 @@ def main(argv=None) -> int:
     ssub = sn.add_subparsers(dest="snap_cmd", required=True)
     st = ssub.add_parser("status")
     st.add_argument("path")
+    rs = ssub.add_parser("restore")
+    rs.add_argument("path")
+    rs.add_argument("--data-dir", required=True)
+    rs.add_argument("--members", type=int, default=3)
 
     h = sub.add_parser("hashkv")
     h.add_argument("--data-dir", required=True)
@@ -115,6 +183,8 @@ def main(argv=None) -> int:
 
     args = p.parse_args(argv)
     if args.cmd == "snapshot":
+        if args.snap_cmd == "restore":
+            return cmd_snapshot_restore(args)
         return cmd_snapshot_status(args)
     if args.cmd == "hashkv":
         return cmd_hashkv(args)

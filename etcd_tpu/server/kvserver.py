@@ -125,6 +125,8 @@ class EtcdCluster:
         quota_bytes: int = 0,
         lease_min_ttl: int = 1,
         data_dir: str | None = None,
+        auth_token: str = "simple",
+        auth_jwt_key: bytes | None = None,
     ):
         self.cl = cluster or Cluster(n_members=n_members)
         self.c = c
@@ -134,8 +136,13 @@ class EtcdCluster:
         self._next_word = 1
         self.data_dir = data_dir
         self._gc_floor = 0  # lowest applied index with payloads retained
+        # --auth-token analog (embed.Config.AuthToken): every member (and
+        # every restart incarnation) shares the provider spec + signing key
+        self.auth_token = auth_token
+        self.auth_jwt_key = auth_jwt_key
         self.members = [
-            MemberState(WatchableStore(), Lessor(lease_min_ttl), AuthStore())
+            MemberState(WatchableStore(), Lessor(lease_min_ttl),
+                        self._new_auth())
             for _ in range(self.M)
         ]
         if data_dir:
@@ -154,6 +161,9 @@ class EtcdCluster:
         import os
 
         return os.path.join(self.data_dir, f"member{m}.db")
+
+    def _new_auth(self) -> AuthStore:
+        return AuthStore(token=self.auth_token, jwt_key=self.auth_jwt_key)
 
     # ------------------------------------------------------------------ raft
     def leader(self) -> int:
@@ -271,12 +281,15 @@ class EtcdCluster:
         member back to the last committed point and WAL/ring replay
         resumes from its consistent index."""
         from etcd_tpu.storage import schema
+        from etcd_tpu.utils import failpoints
 
         kv = ms.store.kv
         sig = (ms.applied_index, kv.current_rev, kv.compact_rev)
         if sig == getattr(ms, "_persist_sig", None):
             return  # nothing applied since the last persist: no-op
-        ms._persist_sig = sig
+        # gofail raftBeforeSave marker (etcdserver/raft.go:221): the batch
+        # is about to be staged behind this member
+        failpoints.fire("raftBeforeSave")
         if kv.compact_rev > ms.persisted_compact:
             schema.persist_compaction(ms.backend, kv)
             ms.persisted_compact = kv.compact_rev
@@ -292,6 +305,12 @@ class EtcdCluster:
             auth_snap=ms.auth.to_snapshot(),
             alarms=ms.alarms,
         )
+        # sig records success only after the batch is fully staged: a crash
+        # at any marker above re-stages the whole batch on the next pump
+        ms._persist_sig = sig
+        # gofail raftAfterSave (etcdserver/raft.go:228): staged but not
+        # necessarily fsync'd — a crash here loses the uncommitted batch
+        failpoints.fire("raftAfterSave")
         # half-full batch -> flush now so the durable floor advances and
         # the payload table can GC (the 100ms batchInterval analog)
         if ms.backend._pending_ops >= ms.backend.batch_limit // 2:
@@ -302,11 +321,14 @@ class EtcdCluster:
     def crash_member(self, m: int) -> None:
         """Simulate a member process crash: all host applied state is
         dropped; only what the backend committed survives on disk."""
+        from etcd_tpu.utils.logging import get_logger
+
+        get_logger().warning("member %d crashed (host state dropped)", m)
         ms = self.members[m]
         if ms.backend is not None:
             ms.backend._f.close()  # no commit: the pending batch is lost
         husk = MemberState(
-            WatchableStore(), Lessor(ms.lessor.min_ttl), AuthStore()
+            WatchableStore(), Lessor(ms.lessor.min_ttl), self._new_auth()
         )
         husk.crashed = True
         self.members[m] = husk
@@ -326,12 +348,24 @@ class EtcdCluster:
             # catch up from the ring / a peer snapshot through _pump
             self.members[m] = MemberState(
                 WatchableStore(),
-                Lessor(self.members[m].lessor.min_ttl), AuthStore(),
+                Lessor(self.members[m].lessor.min_ttl), self._new_auth(),
             )
             self._pump()
             return
 
         be = Backend(self._backend_path(m))
+        ms, _ = self._member_from_backend(be, self.members[m].lessor.min_ttl)
+        self.members[m] = ms
+        # catch up from the device ring (or a peer snapshot if compacted)
+        self._pump()
+
+    def _member_from_backend(
+        self, be, lease_min_ttl: int = 1
+    ) -> tuple[MemberState, dict]:
+        """Rebuild one member's applied state bundle from an open backend
+        (the shared tail of bootstrapBackend, bootstrap.go:145)."""
+        from etcd_tpu.storage import schema
+
         meta = schema.load_applied_meta(be) or {
             "consistent_index": 0, "term": 0, "current_rev": 1,
             "compact_rev": 0, "lease": None, "auth": None, "alarms": [],
@@ -341,8 +375,7 @@ class EtcdCluster:
         )
         ws = WatchableStore()
         ws.restore(store)
-        ms = MemberState(ws, Lessor(self.members[m].lessor.min_ttl),
-                         AuthStore())
+        ms = MemberState(ws, Lessor(lease_min_ttl), self._new_auth())
         if meta["lease"] is not None:
             ms.lessor.restore(meta["lease"])
         if meta["auth"] is not None:
@@ -353,9 +386,57 @@ class EtcdCluster:
         ms.persisted_rev = store.current_rev
         ms.persisted_compact = store.compact_rev
         ms.durable_index = meta["consistent_index"]
-        self.members[m] = ms
-        # catch up from the device ring (or a peer snapshot if compacted)
-        self._pump()
+        return ms, meta
+
+    @classmethod
+    def boot_from_disk(
+        cls,
+        data_dir: str,
+        n_members: int = 3,
+        **kw,
+    ) -> "EtcdCluster":
+        """Boot a cluster from an EXISTING data dir (the bootstrapWithWAL /
+        etcdutl-restore boot path, bootstrap.go:253 +
+        etcdutl/snapshot_command.go:122): each member's applied state
+        machine loads from its backend, and the device raft state starts
+        from a synthetic snapshot at the restored consistent index — the
+        analog of the fresh WAL whose first record is the snapshot marker
+        that `etcdutl snapshot restore` writes. Contrast __init__ with
+        data_dir=..., which wipes for a fresh incarnation."""
+        from etcd_tpu.storage.backend import Backend
+
+        ec = cls(n_members=n_members, **kw)  # memory boot; no wipe
+        ec.data_dir = data_dir
+        metas = []
+        for m in range(ec.M):
+            be = Backend(ec._backend_path(m))
+            ms, meta = ec._member_from_backend(be)
+            ec.members[m] = ms
+            metas.append(meta)
+        idx = max(meta["consistent_index"] for meta in metas)
+        term = max(meta["term"] for meta in metas)
+        for m, meta in enumerate(metas):
+            if meta["consistent_index"] != idx:
+                raise ServerError(
+                    f"member {m} restored at index "
+                    f"{meta['consistent_index']} != {idx}; a restored "
+                    "data dir must be uniform (snapshot restore writes "
+                    "every member from the same snapshot)"
+                )
+        if idx > 0:
+            # synthetic device snapshot: log starts at (idx, term) with an
+            # empty tail, exactly like handle_snapshot's restore field set
+            # (models/raft.py:718-736) minus the config masks, which a
+            # restored cluster keeps at the boot-time full-voter set
+            for m in range(ec.M):
+                ec.cl.set_node(
+                    m, c=ec.c,
+                    term=term, commit=idx, applied=idx, last_index=idx,
+                    snap_index=idx, snap_term=term,
+                    applied_hash=0, snap_hash=0,
+                )
+            ec._gc_floor = idx
+        return ec
 
     def _install_peer_snapshot(self, m: int, ms: "MemberState",
                                need: int) -> None:
@@ -374,7 +455,18 @@ class EtcdCluster:
                 f"has applied that far; host state machine cannot catch up"
             )
         donor = max(donors, key=lambda d: self.members[d].applied_index)
+        from etcd_tpu.utils import failpoints
+        from etcd_tpu.utils.logging import get_logger
+
+        # gofail raftBeforeApplySnap/raftAfterApplySnap
+        # (etcdserver/raft.go:242,256)
+        failpoints.fire("raftBeforeApplySnap")
+        get_logger().info(
+            "installing peer snapshot on member %d from donor %d at "
+            "index %d", m, donor, self.members[donor].applied_index,
+        )
         self.restore_member(m, self.member_snapshot(donor))
+        failpoints.fire("raftAfterApplySnap")
 
     # -- state-machine snapshots (full applied state, not just KV) ----------
     def member_snapshot(self, m: int) -> dict:
@@ -384,6 +476,7 @@ class EtcdCluster:
         ms = self.members[m]
         return {
             "applied_index": ms.applied_index,
+            "term": self.cl.get("term", m, self.c),
             "kv": ms.store.kv.to_snapshot(),
             "lease": ms.lessor.to_snapshot(),
             "auth": ms.auth.to_snapshot(),
@@ -614,8 +707,15 @@ class EtcdCluster:
         return True
 
     # ------------------------------------------------------- request routing
+    # log-if-slower-than threshold for request traces (the
+    # warningApplyDuration dump rule, v3_server.go:602-610), seconds
+    TRACE_THRESHOLD_S = 0.5
+
     def _propose(self, req: dict, member: int | None = None) -> Any:
         """processInternalRaftRequestOnce (v3_server.go:643-704)."""
+        from etcd_tpu.utils.trace import Field, Trace
+
+        trace = Trace(req.get("kind", "?"), Field("member", member))
         lead = self.ensure_leader()
         at = member if member is not None else lead
         # backpressure: commit-apply gap (v3_server.go:644-648)
@@ -628,15 +728,20 @@ class EtcdCluster:
         req["_serve_m"] = at
         self.requests[word] = req
         self.cl.propose(at, word, c=self.c)
+        trace.step("proposed through raft", Field("word", word))
         serving = self.members[at]
-        for _ in range(self.MAX_APPLY_WAIT_ROUNDS):
-            self.step()
-            if word in serving.results:
-                res = serving.results.pop(word)
-                if isinstance(res, Exception):
-                    raise res
-                return res
-        raise ErrTimeout(req["kind"])
+        try:
+            for _ in range(self.MAX_APPLY_WAIT_ROUNDS):
+                self.step()
+                if word in serving.results:
+                    trace.step("applied; result ready")
+                    res = serving.results.pop(word)
+                    if isinstance(res, Exception):
+                        raise res
+                    return res
+            raise ErrTimeout(req["kind"])
+        finally:
+            trace.log_if_long(self.TRACE_THRESHOLD_S)
 
     def _header(self, m: int) -> ResponseHeader:
         s = self.cl.s
@@ -682,13 +787,19 @@ class EtcdCluster:
               count_only: bool = False, token: str | None = None):
         """Range: linearizable by default via ReadIndex barrier
         (v3_server.go:95-133,709)."""
+        from etcd_tpu.utils.trace import Field, Trace
+
+        trace = Trace("range", Field("serializable", serializable))
         self._authz(token, key, range_end, write=False)
         m = member if member is not None else self.ensure_leader()
         if not serializable:
             self.linearizable_read_notify(m)
+            trace.step("read index confirmed; applied caught up")
         kvs, count, used = self.members[m].store.kv.range(
             key, range_end, rev, limit, count_only
         )
+        trace.step("range keys from mvcc", Field("count", count))
+        trace.log_if_long(self.TRACE_THRESHOLD_S)
         return {"kvs": kvs, "count": count, "rev": used,
                 "header": self._header(m)}
 
@@ -776,12 +887,35 @@ class EtcdCluster:
 
     # ----------------------------------------------------------------- watch
     def watch(self, member: int, key: bytes, range_end: bytes | None = None,
-              start_rev: int = 0, prev_kv: bool = False):
-        return self.members[member].store.watch(key, range_end, start_rev, prev_kv)
+              start_rev: int = 0, prev_kv: bool = False,
+              fragment: bool = False, progress_notify: bool = False,
+              filters: tuple = ()):
+        return self.members[member].store.watch(
+            key, range_end, start_rev, prev_kv,
+            fragment=fragment, progress_notify=progress_notify,
+            filters=filters,
+        )
 
-    def watch_events(self, member: int, watch_id: int):
+    def watch_events(self, member: int, watch_id: int,
+                     limit: int | None = None):
         self.members[member].store.sync_watchers()
-        return self.members[member].store.take_events(watch_id)
+        return self.members[member].store.take_events(watch_id, limit)
+
+    def watch_pending(self, member: int, watch_id: int) -> int:
+        return self.members[member].store.pending_events(watch_id)
+
+    def watch_progress(self, member: int, watch_id: int | None = None):
+        """WatchProgressRequest analog. Per-watcher (watch_id given):
+        current revision only if that watcher is synced and drained, else
+        None (mvcc watchStream.RequestProgress). Stream-level
+        (watch_id=None): the bare current revision unconditionally — the
+        reference's ProgressRequest path sends newResponseHeader(Rev())
+        with WatchId -1 without any sync check (api/v3rpc/watch.go:339-345)
+        and leaves interpretation to the client."""
+        store = self.members[member].store
+        if watch_id is not None:
+            return store.progress(watch_id)
+        return store.kv.current_rev
 
     def cancel_watch(self, member: int, watch_id: int) -> bool:
         return self.members[member].store.cancel(watch_id)
@@ -939,6 +1073,12 @@ class EtcdCluster:
             return
         ms = self.members[lead]
         if ms.store.kv.size > self.quota_bytes and "NOSPACE" not in ms.alarms:
+            from etcd_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "quota exceeded (%d > %d bytes); raising NOSPACE alarm",
+                ms.store.kv.size, self.quota_bytes,
+            )
             self.alarm("activate", "NOSPACE")
 
     def snapshot(self, member: int) -> dict:

@@ -189,37 +189,87 @@ class V3Api:
         self.ec.compact(_int(q.get("revision")))
         return {"header": {}}
 
-    # -- watch (create/poll/cancel long-poll mapping) ------------------------
+    # -- watch (create/poll/cancel/progress long-poll mapping) ---------------
+    # fragment budget: the reference splits WatchResponses at the stream's
+    # maxRequestBytes (1.5 MiB default, sendFragments at
+    # api/v3rpc/watch.go:508-545); here the budget bounds the JSON body
+    MAX_WATCH_RESPONSE_BYTES = 3 << 20
+
     def watch(self, q: dict) -> dict:
         if "create_request" in q:
             c = q["create_request"]
+            known = {"NOPUT": "put", "NODELETE": "delete"}
+            bad = [f for f in c.get("filters", []) if f not in known]
+            if bad:
+                raise ServerError(f"unknown watch filters {bad}")
+            filters = tuple(known[f] for f in c.get("filters", []))
             w = self.ec.watch(
                 self._watch_member,
                 _unb64(c["key"]), _unb64(c.get("range_end")),
                 start_rev=_int(c.get("start_revision")),
                 prev_kv=bool(c.get("prev_kv")),
+                fragment=bool(c.get("fragment")),
+                progress_notify=bool(c.get("progress_notify")),
+                filters=filters,
             )
             return {"created": True, "watch_id": str(w.id)}
         if "poll_request" in q:
-            wid = _int(q["poll_request"]["watch_id"])
-            evs = self.ec.watch_events(self._watch_member, wid)
-            return {
-                "watch_id": str(wid),
-                "events": [
-                    {
-                        "type": "PUT" if e.type == "put" else "DELETE",
-                        "kv": _kv_json(e.kv),
-                        **({"prev_kv": _kv_json(e.prev_kv)}
-                           if e.prev_kv else {}),
-                    }
-                    for e in evs
-                ],
-            }
+            return self._watch_poll(q["poll_request"])
+        if "progress_request" in q:
+            # WatchProgressRequest (watch.go:339-345): a bare revision
+            # header, watch_id -1, "broadcast" to the stream
+            rev = self.ec.watch_progress(self._watch_member)
+            return {"watch_id": "-1",
+                    "header": {"revision": str(rev)}}
         if "cancel_request" in q:
             wid = _int(q["cancel_request"]["watch_id"])
             return {"canceled": self.ec.cancel_watch(self._watch_member, wid),
                     "watch_id": str(wid)}
-        raise ServerError("watch: need create/poll/cancel request")
+        raise ServerError("watch: need create/poll/cancel/progress request")
+
+    def _watch_poll(self, p: dict) -> dict:
+        m = self._watch_member
+        wid = _int(p["watch_id"])
+        budget = _int(p.get("max_response_bytes")) or \
+            self.MAX_WATCH_RESPONSE_BYTES
+        store = self.ec.members[m].store
+        watcher = store.get_watcher(wid)
+        frag_on = watcher is not None and watcher.fragment
+        store.sync_watchers()  # one catch-up pass for this poll
+        events, size = [], 0
+        while True:
+            batch = store.take_events(wid, limit=1 if frag_on else None)
+            if not batch:
+                break
+            for e in batch:
+                ej = {
+                    "type": "PUT" if e.type == "put" else "DELETE",
+                    "kv": _kv_json(e.kv),
+                    **({"prev_kv": _kv_json(e.prev_kv)} if e.prev_kv else {}),
+                }
+                events.append(ej)
+                size += len(json.dumps(ej))
+            if not frag_on or size >= budget:
+                break
+        more = self.ec.watch_pending(m, wid) > 0
+        resp = {
+            "watch_id": str(wid),
+            "header": {
+                "revision": str(self.ec.members[m].store.kv.current_rev)
+            },
+            "events": events,
+        }
+        if frag_on and more:
+            # sendFragments: every response but the last is marked
+            resp["fragment"] = True
+        if (not events and not more and watcher is not None
+                and watcher.progress_notify):
+            # idle progress notification (WatchResponse with no events and
+            # a current revision header, watch.go progress path)
+            rev = self.ec.watch_progress(m, wid)
+            if rev is not None:
+                resp["progress_notify"] = True
+        return resp
 
     # -- lease ---------------------------------------------------------------
     def lease_grant(self, q: dict) -> dict:
@@ -298,8 +348,13 @@ class V3Api:
     def maintenance_snapshot(self, q: dict) -> dict:
         m = q.get("_member", self.ec.ensure_leader())
         snap = self.ec.member_snapshot(m)
-        # the gateway streams the backend file; we ship the state snapshot
-        return {"blob": _b64(json.dumps(_jsonable(snap)).encode())}
+        # the reference streams the raw backend file (maintenance.go
+        # Snapshot); our binary-exact equivalent is the pickled member
+        # snapshot — lossless, so `etcdutl snapshot restore` can rebuild
+        # a data dir from the saved file
+        import pickle
+
+        return {"blob": _b64(pickle.dumps(snap, protocol=4))}
 
     def maintenance_defragment(self, q: dict) -> dict:
         for ms in self.ec.members:

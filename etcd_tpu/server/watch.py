@@ -32,7 +32,15 @@ class Watcher:
     range_end: bytes | None
     start_rev: int  # next revision this watcher needs
     prev_kv: bool = False
+    # fragment: client opted into split delivery of oversized event
+    # batches (WatchCreateRequest.Fragment, api/v3rpc/watch.go:303-305)
     fragment: bool = False
+    # progress_notify: client wants periodic empty revision headers when
+    # idle (WatchCreateRequest.ProgressNotify, watch.go:296-298)
+    progress_notify: bool = False
+    # event-type filters (WatchCreateRequest.Filters NOPUT/NODELETE,
+    # watch.go FiltersFromRequest:570-583); lowercase event type names
+    filters: tuple = ()
     buffer: list[Event] = dataclasses.field(default_factory=list)
     # victim: buffer overflowed; excluded from synced until retried
     victim: bool = False
@@ -46,6 +54,11 @@ class Watcher:
         if self.range_end == b"\x00":
             return key >= self.key
         return self.key <= key < self.range_end
+
+    def filtered(self, typ: str) -> bool:
+        """True if events of this type are dropped for this watcher
+        (filterNoPut/filterNoDelete, watch.go:565-568)."""
+        return typ in self.filters
 
 
 class WatchableStore:
@@ -65,6 +78,9 @@ class WatchableStore:
         start_rev: int = 0,
         prev_kv: bool = False,
         watch_id: int = 0,
+        fragment: bool = False,
+        progress_notify: bool = False,
+        filters: tuple = (),
     ) -> Watcher:
         if watch_id == 0:
             watch_id = self._next_id
@@ -72,7 +88,9 @@ class WatchableStore:
         cur = self.kv.current_rev
         if start_rev == 0:
             start_rev = cur + 1
-        w = Watcher(watch_id, key, range_end, start_rev, prev_kv)
+        w = Watcher(watch_id, key, range_end, start_rev, prev_kv,
+                    fragment=fragment, progress_notify=progress_notify,
+                    filters=tuple(filters))
         if start_rev > cur:
             self.synced[watch_id] = w  # watchable_store.go:47-63
         else:
@@ -103,6 +121,11 @@ class WatchableStore:
         for typ, kv, prev in events:
             for w in self.synced.values():
                 if w.victim or not w.matches(kv.key):
+                    continue
+                if w.filtered(typ):
+                    # filtered events are consumed, not deferred: the
+                    # watcher stays current past them
+                    w.start_rev = kv.mod_revision + 1
                     continue
                 if len(w.buffer) >= Watcher.MAX_BUFFER:
                     # slow watcher becomes a victim; it will be re-synced
@@ -178,13 +201,39 @@ class WatchableStore:
                 continue
             if not w.matches(kv.key):
                 continue
-            out.append(Event("delete" if tomb else "put", kv))
+            typ = "delete" if tomb else "put"
+            if w.filtered(typ):
+                continue
+            out.append(Event(typ, kv))
         return out
 
     # -- consumption (serverWatchStream sendLoop analog) ---------------------
-    def take_events(self, watch_id: int) -> list[Event]:
+    def take_events(self, watch_id: int, limit: int | None = None) -> list[Event]:
+        """Drain up to `limit` buffered events (all if None). A fragmenting
+        consumer passes a limit and re-polls; the remainder stays queued."""
         w = self.synced.get(watch_id) or self.unsynced.get(watch_id)
         if w is None:
             return []
-        evs, w.buffer = w.buffer, []
+        if limit is None or len(w.buffer) <= limit:
+            evs, w.buffer = w.buffer, []
+        else:
+            evs, w.buffer = w.buffer[:limit], w.buffer[limit:]
         return evs
+
+    def pending_events(self, watch_id: int) -> int:
+        w = self.synced.get(watch_id) or self.unsynced.get(watch_id)
+        return 0 if w is None else len(w.buffer)
+
+    def get_watcher(self, watch_id: int) -> Watcher | None:
+        return self.synced.get(watch_id) or self.unsynced.get(watch_id)
+
+    def progress(self, watch_id: int) -> int | None:
+        """Revision header for a progress notification: only a synced,
+        fully-drained watcher may report progress (mvcc watchStream.
+        RequestProgress: progress is sent iff the watcher is synced —
+        otherwise the header would claim delivery through a revision whose
+        events are still queued)."""
+        w = self.synced.get(watch_id)
+        if w is None or w.buffer or w.victim:
+            return None
+        return self.kv.current_rev

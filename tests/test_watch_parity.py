@@ -1,0 +1,176 @@
+"""Watch API parity: event filters, progress notification and response
+fragmentation (server/etcdserver/api/v3rpc/watch.go:135-143 stream flags,
+:303-305 fragment, :339-345 WatchProgressRequest, :565-583
+FiltersFromRequest; mvcc watchStream.RequestProgress semantics).
+"""
+import pytest
+
+from etcd_tpu.server.kvserver import EtcdCluster
+from etcd_tpu.server.mvcc import MVCCStore
+from etcd_tpu.server.watch import WatchableStore
+
+
+# ---------------------------------------------------------------------------
+# store-level: filters + progress
+# ---------------------------------------------------------------------------
+
+def test_filter_noput_nodelete_live_path():
+    """filterNoPut/filterNoDelete on the synced notify path."""
+    ws = WatchableStore()
+    w_nop = ws.watch(b"k", filters=("put",))      # NOPUT
+    w_nod = ws.watch(b"k", filters=("delete",))   # NODELETE
+    w_all = ws.watch(b"k")
+    for i in range(4):
+        txn = ws.kv.write_txn()
+        if i % 2 == 0:
+            txn.put(b"k", b"v%d" % i)
+        else:
+            txn.delete_range(b"k")
+        txn.end()
+        ws.notify(txn.events)
+    assert [e.type for e in ws.take_events(w_nop.id)] == ["delete", "delete"]
+    assert [e.type for e in ws.take_events(w_nod.id)] == ["put", "put"]
+    assert [e.type for e in ws.take_events(w_all.id)] == [
+        "put", "delete", "put", "delete"
+    ]
+    # filtered watchers stayed synced (start_rev advanced past every event)
+    assert ws.synced[w_nop.id].start_rev == ws.kv.current_rev + 1
+
+
+def test_filter_applies_to_history_catchup():
+    """Filters also apply on the unsynced/catch-up read (kvsToEvents)."""
+    ws = WatchableStore()
+    for i in range(3):
+        txn = ws.kv.write_txn()
+        txn.put(b"k", b"v%d" % i)
+        txn.end()
+        ws.notify(txn.events)
+    txn = ws.kv.write_txn()
+    txn.delete_range(b"k")
+    txn.end()
+    ws.notify(txn.events)
+    w = ws.watch(b"k", start_rev=1, filters=("put",))
+    assert w.id in ws.unsynced
+    ws.sync_watchers()
+    evs = ws.take_events(w.id)
+    assert [e.type for e in evs] == ["delete"]
+    assert w.id in ws.synced
+
+
+def test_progress_only_when_synced():
+    """mvcc RequestProgress: progress is reported only for a synced,
+    fully-drained watcher — otherwise the header would overclaim."""
+    ws = WatchableStore()
+    w = ws.watch(b"k")
+    assert ws.progress(w.id) == ws.kv.current_rev
+    txn = ws.kv.write_txn()
+    txn.put(b"k", b"x")
+    txn.end()
+    ws.notify(txn.events)
+    assert ws.progress(w.id) is None  # undrained events pending
+    ws.take_events(w.id)
+    assert ws.progress(w.id) == ws.kv.current_rev
+    # an unsynced (catching-up) watcher reports no progress
+    w2 = ws.watch(b"k", start_rev=1)
+    assert ws.progress(w2.id) is None
+
+
+def test_take_events_limit_fragments_buffer():
+    ws = WatchableStore()
+    w = ws.watch(b"k", fragment=True)
+    for i in range(5):
+        txn = ws.kv.write_txn()
+        txn.put(b"k", b"v%d" % i)
+        txn.end()
+        ws.notify(txn.events)
+    first = ws.take_events(w.id, limit=2)
+    assert [e.kv.value for e in first] == [b"v0", b"v1"]
+    assert ws.pending_events(w.id) == 3
+    rest = ws.take_events(w.id)
+    assert [e.kv.value for e in rest] == [b"v2", b"v3", b"v4"]
+    assert ws.pending_events(w.id) == 0
+
+
+# ---------------------------------------------------------------------------
+# server + client level
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ec():
+    ec = EtcdCluster()
+    ec.ensure_leader()
+    return ec
+
+
+def test_client_watch_filters_and_progress(ec):
+    from etcd_tpu.client import Client
+
+    cli = Client(ec)
+    w = cli.watch(b"f/", range_end=b"f0", filters=("put",),
+                  progress_notify=True)
+    cli.put(b"f/1", b"a")
+    cli.put(b"f/2", b"b")
+    cli.delete(b"f/1")
+    assert [e.type for e in w.events()] == ["delete"]
+    # drained + synced => RequestProgress yields the current revision
+    rev = w.request_progress()
+    assert rev == ec.members[ec.ensure_leader()].store.kv.current_rev
+
+
+def test_gateway_watch_fragment_and_progress(ec):
+    """Long-poll gateway: fragment=True splits an oversized batch into
+    fragment-marked responses (sendFragments, watch.go:508-545), and an
+    idle progress_notify watcher gets a bare revision header."""
+    from etcd_tpu.server.v3rpc import V3Api, _b64
+
+    srv = V3Api(ec)
+    create = srv.watch({"create_request": {
+        "key": _b64(b"g/"), "range_end": _b64(b"g0"),
+        "fragment": True, "progress_notify": True,
+    }})
+    wid = create["watch_id"]
+    for i in range(6):
+        ec.put(b"g/%d" % i, b"x" * 50)
+    ec.stabilize()
+    got, frags, polls = [], 0, 0
+    while True:
+        r = srv.watch({"poll_request": {
+            "watch_id": wid, "max_response_bytes": 200,
+        }})
+        polls += 1
+        got += [e["kv"] for e in r["events"]]
+        if r.get("fragment"):
+            frags += 1
+            assert r["events"], "fragments must carry events"
+        else:
+            break
+        assert polls < 20
+    assert len(got) == 6
+    assert frags >= 2  # 6 events * >100B events vs 200B budget
+    # the final (non-fragment) response completed the batch
+    # idle poll now reports progress
+    r = srv.watch({"poll_request": {"watch_id": wid}})
+    assert r["events"] == []
+    assert r.get("progress_notify") is True
+    assert int(r["header"]["revision"]) == \
+        ec.members[ec.ensure_leader()].store.kv.current_rev
+    # stream-level WatchProgressRequest: watch_id -1 broadcast semantics
+    pr = srv.watch({"progress_request": {}})
+    assert pr["watch_id"] == "-1"
+    assert int(pr["header"]["revision"]) >= 1
+
+
+def test_gateway_watch_filters(ec):
+    from etcd_tpu.server.v3rpc import V3Api, _b64
+
+    srv = V3Api(ec)
+    create = srv.watch({"create_request": {
+        "key": _b64(b"h/"), "range_end": _b64(b"h0"),
+        "filters": ["NOPUT"],
+    }})
+    wid = create["watch_id"]
+    ec.put(b"h/1", b"a")
+    ec.delete_range(b"h/1")
+    ec.stabilize()
+    r = srv.watch({"poll_request": {"watch_id": wid}})
+    assert [e["type"] for e in r["events"]] == ["DELETE"]
