@@ -1,0 +1,117 @@
+"""Offline inspection tools — tools/etcd-dump-db and tools/etcd-dump-logs
+analogs.
+
+`dump-db` walks a backend file's buckets/keys (the bbolt inspector:
+tools/etcd-dump-db/backend.go — list buckets, iterate a bucket, decode the
+key bucket's revision records); `dump-logs` prints a WAL directory's
+records in order (tools/etcd-dump-logs/main.go — metadata, hardstates,
+snapshots, entries with type/term/index).
+
+Usage:
+    python -m etcd_tpu.dump db list-bucket <file.db>
+    python -m etcd_tpu.dump db iterate-bucket <file.db> <bucket> [--decode]
+    python -m etcd_tpu.dump logs <wal-dir>
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def dump_db_buckets(path: str) -> list[str]:
+    from etcd_tpu.storage.backend import Backend
+
+    be = Backend(path)
+    try:
+        return sorted(be.data.keys())
+    finally:
+        be.close()
+
+
+def dump_db_bucket(path: str, bucket: str, decode: bool = False):
+    """Yield (key, value-summary) pairs; with decode, revision records in the
+    key bucket pretty-print like dump-db's --decode keyDecoder."""
+    from etcd_tpu.storage import schema
+    from etcd_tpu.storage.backend import Backend
+
+    be = Backend(path)
+    try:
+        for k, v in sorted(be.data.get(bucket, {}).items()):
+            if decode and bucket == schema.KEY_BUCKET:
+                main, sub = schema.bytes_to_rev(k)
+                kv, tomb = schema._dec_kv(v)
+                yield (
+                    f"rev={{{main}/{sub}}}",
+                    {
+                        "key": kv.key.decode("latin1"),
+                        "value": kv.value.decode("latin1"),
+                        "create_revision": kv.create_revision,
+                        "mod_revision": kv.mod_revision,
+                        "version": kv.version,
+                        "lease": kv.lease,
+                        "tombstone": tomb,
+                    },
+                )
+            else:
+                yield (repr(k), f"{len(v)} bytes")
+    finally:
+        be.close()
+
+
+def dump_logs(wal_dir: str) -> dict:
+    """Replay a WAL directory and summarize its records
+    (etcd-dump-logs: WAL metadata + snapshot + hardstate + entries)."""
+    from etcd_tpu.storage.wal import WAL
+
+    w = WAL(wal_dir)
+    metadata, hardstate, entries, snapshot = w.read_all()
+    w.close()
+    return {
+        "metadata": metadata.decode("latin1") if metadata else "",
+        "snapshot": snapshot,
+        "hardstate": hardstate,
+        "entry_count": len(entries),
+        "entries": [
+            {
+                "index": e["index"],
+                "term": e["term"],
+                "type": "conf-change" if e.get("type") else "normal",
+                "data": e["data"],
+            }
+            for e in entries
+        ],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="etcd-dump-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    db = sub.add_parser("db")
+    dsub = db.add_subparsers(dest="db_cmd", required=True)
+    lb = dsub.add_parser("list-bucket")
+    lb.add_argument("path")
+    ib = dsub.add_parser("iterate-bucket")
+    ib.add_argument("path")
+    ib.add_argument("bucket")
+    ib.add_argument("--decode", action="store_true")
+
+    lg = sub.add_parser("logs")
+    lg.add_argument("wal_dir")
+
+    args = p.parse_args(argv)
+    if args.cmd == "db":
+        if args.db_cmd == "list-bucket":
+            for b in dump_db_buckets(args.path):
+                print(b)
+        else:
+            for k, v in dump_db_bucket(args.path, args.bucket, args.decode):
+                print(f"{k} -> {json.dumps(v) if isinstance(v, dict) else v}")
+    else:
+        print(json.dumps(dump_logs(args.wal_dir), indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

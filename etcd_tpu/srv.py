@@ -1,0 +1,95 @@
+"""DNS SRV discovery — client/pkg/srv parity.
+
+The reference bootstraps clusters and client endpoint lists from DNS SRV
+records (`client/pkg/srv/srv.go:35-91` GetCluster, :96-140 GetClient;
+service names composed by GetSRVService). The resolver is pluggable
+(srv.go:26-31 swaps lookupSRV in tests) — this build has no live DNS
+(zero-egress environment), so the default resolver uses the stdlib-free
+hook point and tests/embedders inject records.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SRVRecord:
+    """net.SRV."""
+
+    target: str
+    port: int
+    priority: int = 0
+    weight: int = 0
+
+
+class Resolver:
+    """lookup_srv(service, proto, domain) -> [SRVRecord]; the lookupSRV
+    seam (srv.go:26-31)."""
+
+    def lookup_srv(self, service: str, proto: str, domain: str):
+        raise NotImplementedError(
+            "no live DNS in this environment; inject a resolver with "
+            "SRV records (StaticResolver)"
+        )
+
+
+class StaticResolver(Resolver):
+    """Test/embedder resolver: records keyed by (service, proto, domain)."""
+
+    def __init__(self, records: dict[tuple[str, str, str], list[SRVRecord]]):
+        self.records = records
+
+    def lookup_srv(self, service, proto, domain):
+        return self.records.get((service, proto, domain), [])
+
+
+def get_srv_service(service: str, service_name: str, scheme: str) -> str:
+    """GetSRVService (srv.go GetSRVService): https gets an -ssl suffix."""
+    suffix = "-ssl" if scheme == "https" else ""
+    if service_name:
+        return f"{service}-{service_name}{suffix}"
+    return f"{service}{suffix}"
+
+
+def get_cluster(resolver: Resolver, scheme: str, service: str, name: str,
+                domain: str, apurls: list[str]) -> list[str]:
+    """GetCluster (srv.go:35-91): resolve the service's SRV records into
+    `name=scheme://host:port` initial-cluster parts; the record matching
+    one of our advertised peer urls gets OUR name, others get ordinals."""
+    temp = 0
+    own = set()
+    for u in apurls:
+        hostport = u.split("://", 1)[-1]
+        own.add(hostport)
+    parts = []
+    addrs = resolver.lookup_srv(service, "tcp", domain)
+    if not addrs:
+        raise LookupError(
+            f"error querying DNS SRV records for _{service}._tcp.{domain}"
+        )
+    for srv in addrs:
+        short = srv.target.rstrip(".")
+        hostport = f"{short}:{srv.port}"
+        n = name if hostport in own else str(temp)
+        if hostport not in own:
+            temp += 1
+        parts.append(f"{n}={scheme}://{hostport}")
+    return parts
+
+
+def get_client(resolver: Resolver, service: str, domain: str,
+               service_name: str = "") -> dict:
+    """GetClient (srv.go:96-140): try the https (-ssl) service then the
+    http one; returns {"endpoints": [...], "srvs": [...]}."""
+    endpoints, srvs = [], []
+    for scheme in ("https", "http"):
+        svc = get_srv_service(service, service_name, scheme)
+        for srv in resolver.lookup_srv(svc, "tcp", domain):
+            short = srv.target.rstrip(".")
+            endpoints.append(f"{scheme}://{short}:{srv.port}")
+            srvs.append(srv)
+    if not endpoints:
+        raise LookupError(
+            f"error querying DNS SRV records for _{service}._tcp.{domain}"
+        )
+    return {"endpoints": endpoints, "srvs": srvs}

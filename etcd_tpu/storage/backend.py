@@ -117,6 +117,11 @@ class Backend:
         """Flush + fsync the batch (batchTxBuffered.commit)."""
         if not self._pending:
             return
+        from etcd_tpu.utils import failpoints
+
+        # gofail beforeCommit/afterCommit analogs (backend/batch_tx.go's
+        # commit path; tester/case_failpoints.go trips these mid-batch)
+        failpoints.fire("backendBeforeCommit")
         blob = b"".join(self._pending)
         self._f.write(blob)
         self._f.flush()
@@ -124,6 +129,7 @@ class Backend:
         self._size_logical += len(blob)
         self._pending = []
         self._pending_ops = 0
+        failpoints.fire("backendAfterCommit")
 
     # -- reads (always see the buffered view, like txReadBuffer) -------------
     def get(self, bucket: str, key: bytes) -> bytes | None:

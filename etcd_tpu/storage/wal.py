@@ -137,6 +137,11 @@ class WAL:
                         # into a silent hole
                         raise WALError(f"corrupt record mid-log in {seg}")
                     # torn tail: truncate and stop replay (repair.go)
+                    from etcd_tpu.utils.logging import get_logger
+
+                    get_logger().warning(
+                        "repaired torn wal tail in %s at offset %d", seg, off
+                    )
                     with open(path, "ab") as f:
                         f.truncate(off)
                     break

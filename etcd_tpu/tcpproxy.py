@@ -1,0 +1,150 @@
+"""L4 TCP gateway — server/proxy/tcpproxy parity (the `etcd gateway`
+command, etcdmain/gateway.go).
+
+The reference's TCPProxy (proxy/tcpproxy/userspace.go) accepts TCP
+connections and forwards raw bytes to one of a set of backend endpoints:
+round-robin pick, dead endpoints marked inactive and retried after a
+monitor interval, SRV-weighted remotes treated as a flat list here (the
+weights only matter with DNS SRV priorities, srv.py).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+
+class Remote:
+    """One backend endpoint (userspace.go `remote`): address + liveness."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self.inactive = False
+        self._mu = threading.Lock()
+
+    def inactivate(self) -> None:
+        with self._mu:
+            self.inactive = True
+
+    def is_active(self) -> bool:
+        with self._mu:
+            return not self.inactive
+
+    def try_reactivate(self) -> bool:
+        """Dial-and-close probe (userspace.go tryReactivate)."""
+        try:
+            with socket.create_connection((self.host, self.port), timeout=1):
+                pass
+        except OSError:
+            return False
+        with self._mu:
+            self.inactive = False
+        return True
+
+
+class TCPProxy:
+    """userspace.go TCPProxy: serve(), pick(), io pump per connection."""
+
+    def __init__(self, endpoints: list[tuple[str, int]],
+                 host: str = "127.0.0.1", port: int = 0,
+                 monitor_interval: float = 5.0):
+        self.remotes = [Remote(h, p) for h, p in endpoints]
+        self._rr = 0
+        self._mu = threading.Lock()
+        self.monitor_interval = monitor_interval
+        self._listener = socket.create_server((host, port))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- endpoint pick (round-robin over active remotes) ---------------------
+    def pick(self) -> Remote | None:
+        with self._mu:
+            n = len(self.remotes)
+            for i in range(n):
+                r = self.remotes[(self._rr + i) % n]
+                if r.is_active():
+                    self._rr = (self._rr + i + 1) % n
+                    return r
+        return None
+
+    # -- serving -------------------------------------------------------------
+    def start(self) -> "TCPProxy":
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        m = threading.Thread(target=self._monitor_loop, daemon=True)
+        m.start()
+        self._threads.append(m)
+        return self
+
+    def _accept_loop(self) -> None:
+        self._listener.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        """Forward one client connection to the first dialable remote
+        (userspace.go serve: try picks until one dials, inactivating
+        failures)."""
+        backend = None
+        for _ in range(len(self.remotes)):
+            r = self.pick()
+            if r is None:
+                break
+            try:
+                backend = socket.create_connection((r.host, r.port),
+                                                   timeout=2)
+                break
+            except OSError:
+                r.inactivate()
+        if backend is None:
+            conn.close()
+            return
+        a = threading.Thread(target=self._pump, args=(conn, backend),
+                             daemon=True)
+        b = threading.Thread(target=self._pump, args=(backend, conn),
+                             daemon=True)
+        a.start()
+        b.start()
+
+    @staticmethod
+    def _pump(src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def _monitor_loop(self) -> None:
+        """runMonitor (userspace.go): periodically re-probe inactive
+        remotes."""
+        while not self._stop.wait(self.monitor_interval):
+            for r in self.remotes:
+                if not r.is_active():
+                    r.try_reactivate()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass

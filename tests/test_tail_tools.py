@@ -1,0 +1,205 @@
+"""Minor-tail components: request tracing (pkg/traceutil), dump tools
+(tools/etcd-dump-db, etcd-dump-logs), the L4 tcpproxy gateway
+(server/proxy/tcpproxy) and DNS SRV discovery (client/pkg/srv).
+"""
+import json
+import socket
+import threading
+
+import pytest
+
+
+# ---------------------------------------------------------------------------
+# traceutil
+# ---------------------------------------------------------------------------
+
+def test_trace_steps_and_format():
+    import time
+
+    from etcd_tpu.utils.trace import Field, Trace
+
+    t = Trace("put", Field("size", 3))
+    t.step("proposed")
+    time.sleep(0.01)
+    t.step("applied", Field("rev", 7))
+    out = t.format()
+    assert "put" in out and "{size:3; }" in out
+    assert "step proposed" in out and "step applied {rev:7; }" in out
+    assert t.duration() >= 0.01
+
+
+def test_trace_log_threshold(monkeypatch):
+    import time
+
+    from etcd_tpu.utils import logging as lg
+    from etcd_tpu.utils.trace import Trace
+
+    records = []
+
+    class Cap(lg.Logger):
+        def debug(self, f, *a): pass
+        def info(self, f, *a): pass
+        def warning(self, f, *a): records.append(f % a)
+        def error(self, f, *a): pass
+
+    old = lg.get_logger()
+    lg.set_logger(Cap())
+    try:
+        fast = Trace("fast")
+        assert not fast.log_if_long(10.0)
+        slow = Trace("slow")
+        time.sleep(0.02)
+        assert slow.log_if_long(0.01)
+        assert records and "slow" in records[0]
+        assert not Trace.todo().log_if_long(0.0)  # TODO trace never logs
+    finally:
+        lg.set_logger(old)
+
+
+def test_trace_add_field_replaces():
+    from etcd_tpu.utils.trace import Field, Trace
+
+    t = Trace("x", Field("k", 1))
+    t.add_field(Field("k", 2), Field("j", 3))
+    assert {f.key: f.value for f in t.fields} == {"k": 2, "j": 3}
+
+
+# ---------------------------------------------------------------------------
+# dump tools
+# ---------------------------------------------------------------------------
+
+def test_dump_db_and_logs(tmp_path, capsys):
+    from etcd_tpu import dump
+    from etcd_tpu.server.mvcc import MVCCStore
+    from etcd_tpu.storage import schema
+    from etcd_tpu.storage.backend import Backend
+    from etcd_tpu.storage.wal import WAL
+
+    # build a small backend with two revisions
+    db = str(tmp_path / "m.db")
+    be = Backend(db, fresh=True)
+    st = MVCCStore()
+    txn = st.write_txn()
+    txn.put(b"a", b"1")
+    txn.end()
+    txn = st.write_txn()
+    txn.delete_range(b"a")
+    txn.end()
+    schema.persist_mvcc_delta(be, st, 0)
+    schema.save_applied_meta(be, index=2, term=1, store=st, lease_snap=None,
+                             auth_snap=None, alarms=[])
+    be.commit()
+    be.close()
+
+    assert dump.main(["db", "list-bucket", db]) == 0
+    buckets = capsys.readouterr().out.split()
+    assert "key" in buckets and "meta" in buckets
+
+    assert dump.main(["db", "iterate-bucket", db, "key", "--decode"]) == 0
+    out = capsys.readouterr().out
+    assert "rev={2/0}" in out and "rev={3/0}" in out
+    assert '"tombstone": true' in out
+
+    # WAL dump
+    wdir = str(tmp_path / "wal")
+    w = WAL(wdir, metadata=b"meta-1")
+    w.save_snapshot(0, 0)
+    w.save({"term": 1, "vote": 0, "commit": 0},
+           [{"index": 1, "term": 1, "data": 11, "type": 0},
+            {"index": 2, "term": 1, "data": 22, "type": 1}])
+    w.close()
+    assert dump.main(["logs", wdir]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["metadata"] == "meta-1"
+    assert rep["snapshot"] == {"index": 0, "term": 0}
+    assert rep["entry_count"] == 2
+    assert rep["entries"][1]["type"] == "conf-change"
+    assert rep["hardstate"]["term"] == 1
+
+
+# ---------------------------------------------------------------------------
+# tcpproxy
+# ---------------------------------------------------------------------------
+
+def _echo_server():
+    """A TCP backend that answers b'pong:' + payload once per connection."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def loop():
+        srv.settimeout(5)
+        try:
+            while True:
+                conn, _ = srv.accept()
+                data = conn.recv(1024)
+                conn.sendall(b"pong:" + data)
+                conn.close()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return srv, port
+
+
+def test_tcpproxy_forwards_and_fails_over():
+    from etcd_tpu.tcpproxy import TCPProxy
+
+    srv, port = _echo_server()
+    # first endpoint is dead: proxy must inactivate it and fail over
+    dead = socket.create_server(("127.0.0.1", 0))
+    dead_port = dead.getsockname()[1]
+    dead.close()
+
+    proxy = TCPProxy([("127.0.0.1", dead_port), ("127.0.0.1", port)],
+                     monitor_interval=0.2).start()
+    try:
+        for _ in range(2):  # round-robin across picks, both land on live
+            with socket.create_connection((proxy.host, proxy.port),
+                                          timeout=5) as c:
+                c.sendall(b"hi")
+                c.settimeout(5)
+                assert c.recv(1024) == b"pong:hi"
+        assert not proxy.remotes[0].is_active()
+        assert proxy.remotes[1].is_active()
+    finally:
+        proxy.stop()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# srv discovery
+# ---------------------------------------------------------------------------
+
+def test_srv_get_cluster_and_client():
+    from etcd_tpu.srv import SRVRecord, StaticResolver, get_client, get_cluster
+
+    res = StaticResolver({
+        ("etcd-server", "tcp", "example.com"): [
+            SRVRecord("m0.example.com.", 2380),
+            SRVRecord("m1.example.com.", 2380),
+            SRVRecord("m2.example.com.", 2380),
+        ],
+        ("etcd-client", "tcp", "example.com"): [
+            SRVRecord("c0.example.com.", 2379),
+        ],
+        ("etcd-client-ssl", "tcp", "example.com"): [
+            SRVRecord("s0.example.com.", 2379),
+        ],
+    })
+    parts = get_cluster(
+        res, "http", "etcd-server", "me", "example.com",
+        apurls=["http://m1.example.com:2380"],
+    )
+    assert parts == [
+        "0=http://m0.example.com:2380",
+        "me=http://m1.example.com:2380",
+        "1=http://m2.example.com:2380",
+    ]
+    cl = get_client(res, "etcd-client", "example.com")
+    assert cl["endpoints"] == [
+        "https://s0.example.com:2379",
+        "http://c0.example.com:2379",
+    ]
+    with pytest.raises(LookupError):
+        get_cluster(res, "http", "nope", "x", "example.com", [])
