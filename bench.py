@@ -80,8 +80,14 @@ def main() -> None:
     # throughput path is batch scale, not unrolling. BENCH_UNROLL=1
     # opts back into the unrolled program.
     unroll = os.environ.get("BENCH_UNROLL", "0") != "0"
+    # inbox_bound=M-1: lossless in the one-proposal-per-round steady state
+    # (leader sees M-1 acks, followers 1 append; see RaftConfig.inbox_bound
+    # and tests/test_inbox_compaction.py), and cuts the dominant serial
+    # message loop from M*K+3 to bound+3 steps per round.
+    bound = int(os.environ.get("BENCH_INBOX", str(spec.M - 1)))
     cfg = RaftConfig(pre_vote=True, check_quorum=True,
-                     unroll_messages=unroll, max_inflight=min(4, W))
+                     unroll_messages=unroll, max_inflight=min(4, W),
+                     inbox_bound=bound, coalesce_commit_refresh=True)
     M, E = spec.M, spec.E
 
     devs = jax.devices()
@@ -102,7 +108,9 @@ def main() -> None:
 
     # -- elect leaders: campaign node 0 everywhere, settle the cascade ------
     step = (
-        jax.jit(build_round(cfg, spec))
+        # donate the fleet buffers: at C=1M state+inbox are ~6GB and the
+        # settle phase would otherwise double-buffer them
+        jax.jit(build_round(cfg, spec), donate_argnums=(0, 1))
         if mesh is None
         else build_scan_rounds(cfg, spec, mesh, rounds=1)
     )
@@ -172,7 +180,8 @@ def main() -> None:
     import dataclasses as _dc
 
     met_cfg = _dc.replace(cfg, unroll_messages=False)
-    met_step = jax.jit(build_metered_round(met_cfg, spec))
+    met_step = jax.jit(build_metered_round(met_cfg, spec),
+                       donate_argnums=(0, 1))
     metrics = zero_metrics()
     mrounds = 8
     t0 = time.perf_counter()

@@ -36,11 +36,20 @@ def main() -> int:
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
-    C = int(os.environ.get("CHAOS_C", 100_000 if on_accel else 1_000))
+    C = int(os.environ.get("CHAOS_C", 262_144 if on_accel else 1_000))
     rounds = int(os.environ.get("CHAOS_ROUNDS", 200))
 
-    spec = Spec(M=5, L=32, E=2, K=4, W=2, R=2, A=4)
-    cfg = RaftConfig(pre_vote=True, check_quorum=True)
+    # bench geometry (bench.py Spec) so the chaos tier proves the measured
+    # configuration safe under faults — K=2 slots suffice because drops
+    # are legal and counted; L=32 keeps slack for fault-delayed applies.
+    # CHAOS_BOUND trims the serial message loop like BENCH_INBOX; 8 covers
+    # every non-self inbox slot (K*(M-1)), so nothing a fault didn't
+    # already drop is lost.
+    L = int(os.environ.get("CHAOS_L", "32"))
+    spec = Spec(M=5, L=L, E=1, K=2, W=4, R=2, A=2)
+    bound = int(os.environ.get("CHAOS_BOUND", str(spec.K * (spec.M - 1))))
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                     inbox_bound=bound, coalesce_commit_refresh=True)
 
     t0 = time.perf_counter()
     rep = run_chaos(

@@ -46,7 +46,15 @@ from etcd_tpu.models.state import (
 from etcd_tpu.ops import inflights as infl
 from etcd_tpu.ops import log as logops
 from etcd_tpu.ops import quorum
-from etcd_tpu.ops.outbox import Outbox, bcast, emit, emit_one, empty_outbox, make_msg
+from etcd_tpu.ops.outbox import (
+    Outbox,
+    bcast,
+    emit,
+    emit_one,
+    empty_outbox,
+    make_msg,
+    record_sent_commit,
+)
 from etcd_tpu.types import (
     CAMPAIGN_FORCE,
     CAMPAIGN_NONE,
@@ -347,6 +355,7 @@ def maybe_send_append(
         ent_type=e_type,
     )
     ob = emit(spec, ob, send_app, app)
+    ob = record_sent_commit(ob, send_app, n.commit)
 
     has_ents = send_app & (ln > 0)
     repl = n.pr_state == PR_REPLICATE
@@ -381,6 +390,7 @@ def maybe_send_append(
         ),
     )
     ob = emit(spec, ob, send_snap, snap)
+    ob = record_sent_commit(ob, send_snap, n.commit)
     n = n.replace(
         pr_state=jnp.where(send_snap, PR_SNAPSHOT, n.pr_state),
         pending_snapshot=jnp.where(send_snap, n.applied, n.pending_snapshot),
@@ -410,7 +420,9 @@ def bcast_heartbeat(cfg, spec, n, ob, ctx, enable) -> tuple[NodeState, Outbox]:
         commit=jnp.minimum(n.match, n.commit),
         context=jnp.broadcast_to(jnp.asarray(ctx, jnp.int32), (spec.M,)),
     )
-    return n, emit(spec, ob, to, msg)
+    ob = emit(spec, ob, to, msg)
+    ob = record_sent_commit(ob, to, jnp.minimum(n.match, n.commit))
+    return n, ob
 
 
 # ---------------------------------------------------------------------------
@@ -885,10 +897,16 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
     # merged send: commit-advance broadcast (raft.go:1259-1263) OR
     # refresh/drain to the acking follower (1264-1276) OR the reject-path
     # re-probe (1230-1236); one maybe_send_append inlining covers all three.
-    send_dest = jnp.where(
-        committed_adv, _progress_ids(n), fhot & (updated | decremented)
-    )
-    send_nonempty = committed_adv | decremented | old_paused_f
+    if cfg.coalesce_commit_refresh:
+        # commit-advance broadcast deferred to node_round's end-of-round
+        # flush (see RaftConfig.coalesce_commit_refresh)
+        send_dest = fhot & (updated | decremented)
+        send_nonempty = decremented | old_paused_f
+    else:
+        send_dest = jnp.where(
+            committed_adv, _progress_ids(n), fhot & (updated | decremented)
+        )
+        send_nonempty = committed_adv | decremented | old_paused_f
     n, ob = maybe_send_append(cfg, spec, n, ob, send_dest, send_nonempty)
 
     # leadership transfer (raft.go:1278-1281)
@@ -1298,6 +1316,34 @@ def apply_round(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox):
 # ---------------------------------------------------------------------------
 
 
+def compact_inbox(spec: Spec, flat: Msg, bound: int) -> Msg:
+    """Compact a node's flattened inbox [S=M*K, ...] to its first `bound`
+    nonempty slots (original order kept); later messages are dropped.
+
+    The slot->slot routing is a one-hot contraction (sel[b, s] = slot s is
+    the b-th nonempty), not a gather: at fleet shapes the [B, S] plane is
+    tiny next to C and the multiply-sum fuses into the reduction, while a
+    batched gather materializes per-(node, cluster) index tensors.
+    See RaftConfig.inbox_bound for the drop-legality argument."""
+    S = flat.type.shape[0]
+    B = min(bound, S)
+    if B >= S:
+        return flat
+    nonempty = flat.type != MSG_NONE                       # [S]
+    rank = jnp.cumsum(nonempty.astype(jnp.int32)) - 1      # [S]
+    sel = (
+        rank[None, :] == jnp.arange(B, dtype=jnp.int32)[:, None]
+    ) & nonempty[None, :]                                  # [B, S]
+
+    def take(x):
+        s = sel.reshape(sel.shape + (1,) * (x.ndim - 1))
+        if x.dtype == jnp.bool_:
+            return (s & x[None]).any(axis=1)
+        return (s.astype(x.dtype) * x[None]).sum(axis=1)
+
+    return jax.tree.map(take, flat)
+
+
 def node_round(
     cfg: RaftConfig,
     spec: Spec,
@@ -1314,6 +1360,7 @@ def node_round(
     read-index] message scan -> apply. Returns (state, outbox)."""
     ob = empty_outbox(spec)
     n, ob, fire = tick_timers(cfg, spec, n, ob, jnp.asarray(do_tick, jnp.bool_))
+    commit0 = n.commit  # round-start commit, for the coalesced flush below
 
     hup_msg = make_msg(spec, frm=n.nid).replace(
         type=jnp.where(do_hup | fire, MSG_HUP, MSG_NONE),
@@ -1342,6 +1389,9 @@ def node_round(
     flat = jax.tree.map(
         lambda x: x.reshape((spec.M * spec.K,) + x.shape[2:]), inbox
     )
+    if cfg.inbox_bound:
+        flat = compact_inbox(spec, flat, cfg.inbox_bound)
+    n_slots = flat.type.shape[0]
     if cfg.unroll_messages:
         # Unrolled message loop: a lax.scan costs one while-loop iteration
         # of fixed runtime overhead (~10-25ms measured on the TPU runtime)
@@ -1353,7 +1403,7 @@ def node_round(
         # one-hot ring-roll matrices are O(L^2 * C)) live at once and the
         # unrolled program OOMs at fleet C (observed 37G at C=8k); the
         # barrier makes step i's scratch die before step i+1 allocates.
-        for i in range(spec.M * spec.K):
+        for i in range(n_slots):
             m = jax.tree.map(lambda x: x[i], flat)
             n, ob = process_message(cfg, spec, n, ob, m)
             n, ob = jax.lax.optimization_barrier((n, ob))
@@ -1367,6 +1417,20 @@ def node_round(
 
     n, ob = process_message(cfg, spec, n, ob, prop_msg)
     n, ob = process_message(cfg, spec, n, ob, ri_msg)
+
+    if cfg.coalesce_commit_refresh:
+        # End-of-round commit flush, replacing the per-ack bcastAppend
+        # suppressed in _step_leader: if this round advanced the leader's
+        # commit, send one (possibly empty) append to every follower whose
+        # messages this round (if any) carried a now-stale commit — e.g. a
+        # round-start heartbeat emitted before the acks advanced commit.
+        # sent_commit tracks the best commit each dest already received.
+        stale = ob.sent_commit < jnp.minimum(n.match, n.commit)
+        refresh = (
+            (n.role == ROLE_LEADER) & (n.commit > commit0)
+            & _progress_ids(n) & ((ob.counts == 0) | stale)
+        )
+        n, ob = maybe_send_append(cfg, spec, n, ob, refresh, True)
 
     n, ob = apply_round(cfg, spec, n, ob)
     return n, ob

@@ -34,6 +34,11 @@ class Outbox(struct.PyTreeNode):
     # emit() views them as [K, M, (E)] via free reshapes.
     msgs: Msg
     counts: jnp.ndarray    # i32[M]
+    # highest commit index carried by any message sent to each dest this
+    # round (0 = none). Consumed by the coalesced end-of-round commit
+    # flush (RaftConfig.coalesce_commit_refresh) to detect destinations
+    # whose only messages this round predate a commit advance.
+    sent_commit: jnp.ndarray  # i32[M]
 
 
 def _view(spec: Spec, name: str, x: jnp.ndarray) -> jnp.ndarray:
@@ -50,7 +55,8 @@ def empty_outbox(spec: Spec) -> Outbox:
         return jnp.zeros((n,), x.dtype)
 
     msgs = Msg(**{k: mk(k, getattr(m, k)) for k in Msg.__dataclass_fields__})
-    return Outbox(msgs=msgs, counts=jnp.zeros((spec.M,), jnp.int32))
+    return Outbox(msgs=msgs, counts=jnp.zeros((spec.M,), jnp.int32),
+                  sent_commit=jnp.zeros((spec.M,), jnp.int32))
 
 
 def make_msg(spec: Spec, **kw) -> Msg:
@@ -85,7 +91,18 @@ def emit(spec: Spec, ob: Outbox, to_mask: jnp.ndarray, m: Msg) -> Outbox:
         return jnp.where(s, new[None], old).reshape(-1)
 
     msgs = Msg(**{k: upd(k) for k in Msg.__dataclass_fields__})
-    return Outbox(msgs=msgs, counts=ob.counts + can.astype(jnp.int32))
+    return Outbox(msgs=msgs, counts=ob.counts + can.astype(jnp.int32),
+                  sent_commit=ob.sent_commit)
+
+
+def record_sent_commit(ob: Outbox, mask: jnp.ndarray, value) -> Outbox:
+    """Note that destinations in `mask` just received a message carrying
+    commit information `value` ([M] or scalar)."""
+    return ob.replace(
+        sent_commit=jnp.where(
+            mask, jnp.maximum(ob.sent_commit, value), ob.sent_commit
+        )
+    )
 
 
 def emit_one(

@@ -35,6 +35,32 @@ class RaftConfig:
     # compile, which is wrong for the (CPU, many-Spec) test suite. Perf
     # paths (bench, entry) turn this on.
     unroll_messages: bool = False
+    # Compact each node's inbox (nonempty slots to the front, original
+    # order preserved) and process only the first `inbox_bound` slots per
+    # round instead of all M*K. Messages past the bound are DROPPED —
+    # legal by the transport contract ("Send MUST NOT block / drop is OK",
+    # etcdserver/raft.go:107-110; rafttest/network.go:106-108) and
+    # recovered by Raft's own retransmission/re-election machinery. The
+    # round program's dominant cost is the serial per-slot message loop
+    # (profiled: each slot replays the full masked step), so bounding the
+    # live slots is a direct round-time multiplier. In the replication
+    # steady state a node receives at most max(M-1, K) messages per round
+    # (the leader's M-1 acks), so inbox_bound=M-1 is lossless there.
+    # 0 disables (test/golden paths: exact slot semantics).
+    inbox_bound: int = 0
+    # Coalesce the leader's commit-index propagation: suppress the empty
+    # commit-refresh MsgApp fired while processing each MsgAppResp
+    # (raft.go:1259-1263 bcastAppend-on-commit) and instead flush ONE
+    # (possibly empty) append at end of round to every follower that got
+    # no message this round. In the lockstep engine an ack-driven refresh
+    # and a same-round proposal append carry the same commit index, so
+    # the refresh is redundant whenever the round also proposes — with
+    # coalescing the steady state is exactly one append + one ack per
+    # follower per round (half the message load, and inbox_bound=M-1
+    # becomes lossless). Suppressing a send is legal by the transport
+    # drop contract; the end-of-round flush preserves commit liveness.
+    # Off for the golden/test paths (exact reference message schedule).
+    coalesce_commit_refresh: bool = False
 
     def __post_init__(self):
         if self.heartbeat_tick <= 0:
