@@ -68,7 +68,7 @@ def main() -> None:
     # BENCH_L trims the log ring for the 1M-group configuration: state is
     # ring-dominated (~3KB/cluster at L=32), and the steady state needs
     # only enough ring for the commit->apply pipeline (L > 2E + lag).
-    L = int(os.environ.get("BENCH_L", "32"))
+    L = int(os.environ.get("BENCH_L", "16"))
     W = int(os.environ.get("BENCH_W", "4"))
     spec = Spec(M=5, L=L, E=1, K=2, W=W, R=2, A=2)
     # Default to the lax.scan round program. Profiling the unrolled

@@ -354,7 +354,9 @@ def maybe_send_append(
         ent_data=e_data,
         ent_type=e_type,
     )
-    ob = emit(spec, ob, send_app, app)
+    ob = emit(spec, ob, send_app, app,
+              fields=("index", "log_term", "commit", "ent_len",
+                      "ent_term", "ent_data", "ent_type"))
     ob = record_sent_commit(ob, send_app, n.commit)
 
     has_ents = send_app & (ln > 0)
@@ -389,7 +391,9 @@ def maybe_send_append(
             pack_mask(n.learners_next), (spec.M,)
         ),
     )
-    ob = emit(spec, ob, send_snap, snap)
+    ob = emit(spec, ob, send_snap, snap,
+              fields=("index", "log_term", "commit", "c_voters",
+                      "c_voters_out", "c_learners", "c_learners_next"))
     ob = record_sent_commit(ob, send_snap, n.commit)
     n = n.replace(
         pr_state=jnp.where(send_snap, PR_SNAPSHOT, n.pr_state),
@@ -420,7 +424,7 @@ def bcast_heartbeat(cfg, spec, n, ob, ctx, enable) -> tuple[NodeState, Outbox]:
         commit=jnp.minimum(n.match, n.commit),
         context=jnp.broadcast_to(jnp.asarray(ctx, jnp.int32), (spec.M,)),
     )
-    ob = emit(spec, ob, to, msg)
+    ob = emit(spec, ob, to, msg, fields=("commit",))
     ob = record_sent_commit(ob, to, jnp.minimum(n.match, n.commit))
     return n, ob
 
@@ -452,7 +456,7 @@ def campaign(cfg, spec, n: NodeState, ob: Outbox, kind, enable):
             index=jnp.broadcast_to(npre.last_index, (spec.M,)),
             log_term=jnp.broadcast_to(lt, (spec.M,)),
         )
-        ob = emit(spec, ob, to, msg)
+        ob = emit(spec, ob, to, msg, fields=("index", "log_term"))
         n = tree_where(pre, npre, n)
         do_real = enable & jnp.where(pre, won_pre, True)
     else:
@@ -473,7 +477,7 @@ def campaign(cfg, spec, n: NodeState, ob: Outbox, kind, enable):
             jnp.where(kind == CAMPAIGN_TRANSFER, CAMPAIGN_TRANSFER, 0), (spec.M,)
         ),
     )
-    ob = emit(spec, ob, to, msg)
+    ob = emit(spec, ob, to, msg, fields=("index", "log_term"))
     nr = tree_where(won, become_leader_state(cfg, spec, nr), nr)
     n = tree_where(do_real, nr, n)
     return n, ob
@@ -501,6 +505,7 @@ def _emit_hup_to_self(spec, n, ob, kind, enable):
         n.nid,
         make_msg(spec, type=MSG_HUP, frm=n.nid, context=kind),
         enable,
+        fields=(),
     )
 
 
@@ -574,6 +579,7 @@ def _ro_advance_emit(cfg, spec, n: NodeState, ob: Outbox, ctx, enable):
                 context=n.ro_ctx[r],
             ),
             released[r] & ~local,
+            fields=("index",),
         )
     shift = jnp.where(found, pos + 1, 0)
 
@@ -621,6 +627,7 @@ def _send_read_index_response(cfg, spec, n, ob, ctx, frm, enable):
                 context=ctx,
             ),
             enable & ~local,
+            fields=("index",),
         )
         return n, ob
     n = _ro_add_request(spec, n, ctx, frm, enable)
@@ -653,6 +660,7 @@ def handle_append_entries(cfg, spec, n, ob, m: Msg, enable):
         m.frm,
         make_msg(spec, type=MSG_APP_RESP, term=n.term, frm=n.nid, index=n.commit),
         enable & below,
+        fields=("index",),
     )
     en = enable & ~below
     # ring-capacity partial accept: entries past snap_index + L can't be
@@ -668,6 +676,7 @@ def handle_append_entries(cfg, spec, n, ob, m: Msg, enable):
         m.frm,
         make_msg(spec, type=MSG_APP_RESP, term=n.term, frm=n.nid, index=lastnewi),
         en & ok,
+        fields=("index",),
     )
     hint_index = jnp.minimum(m.index, n.last_index)
     hint_index = logops.find_conflict_by_term(spec, n, hint_index, m.log_term)
@@ -687,6 +696,7 @@ def handle_append_entries(cfg, spec, n, ob, m: Msg, enable):
             log_term=hint_term,
         ),
         en & ~ok,
+        fields=("index", "reject_hint", "log_term"),
     )
     return n, ob
 
@@ -702,6 +712,7 @@ def handle_heartbeat(cfg, spec, n, ob, m: Msg, enable):
             spec, type=MSG_HEARTBEAT_RESP, term=n.term, frm=n.nid, context=m.context
         ),
         enable,
+        fields=(),
     )
     return n, ob
 
@@ -760,6 +771,7 @@ def handle_snapshot(cfg, spec, n, ob, m: Msg, enable):
             index=jnp.where(do_restore, n.last_index, n.commit),
         ),
         enable & follower,
+        fields=("index",),
     )
     return n, ob
 
@@ -818,6 +830,7 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
             index=n.commit, context=m.context,
         ),
         is_ri & singleton & ~local,
+        fields=("index",),
     )
     cit = _committed_in_term(spec, n)
     # defer until first commit at this term (raft.go:1087-1092)
@@ -917,6 +930,7 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
         m.frm,
         make_msg(spec, type=MSG_TIMEOUT_NOW, term=n.term, frm=n.nid),
         xfer,
+        fields=(),
     )
 
     # ---- MsgHeartbeatResp (raft.go:1284-1309)
@@ -985,6 +999,7 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
         m.frm,
         make_msg(spec, type=MSG_TIMEOUT_NOW, term=n.term, frm=n.nid),
         do_tl & up_to_date,
+        fields=(),
     )
     n, ob = maybe_send_append(cfg, spec, n, ob, fhot & do_tl & ~up_to_date, True)
     return n, ob
@@ -1104,6 +1119,7 @@ def process_message(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, m: Ms
         m.frm,
         make_msg(spec, type=MSG_APP_RESP, term=n.term, frm=n.nid),
         lt_push,
+        fields=(),
     )
     lt_prevote = lower & (m.type == MSG_PRE_VOTE)
     ob = emit_one(
@@ -1112,6 +1128,7 @@ def process_message(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, m: Ms
         m.frm,
         make_msg(spec, type=MSG_PRE_VOTE_RESP, term=n.term, frm=n.nid, reject=True),
         lt_prevote,
+        fields=(),
     )
     proceed = active & ~drop_lease & ~lower
 
@@ -1138,6 +1155,7 @@ def process_message(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, m: Ms
             reject=~grant,
         ),
         is_vreq,
+        fields=(),
     )
     real_grant = grant & (m.type == MSG_VOTE)
     n = n.replace(
