@@ -85,9 +85,16 @@ def main() -> None:
     # and tests/test_inbox_compaction.py), and cuts the dominant serial
     # message loop from M*K+3 to bound+3 steps per round.
     bound = int(os.environ.get("BENCH_INBOX", str(spec.M - 1)))
+    # fleet chunking caps peak HLO-temp HBM (RaftConfig.fleet_chunks):
+    # default keeps each resident chunk at <= 262,144 clusters, the
+    # largest single-chunk configuration measured to fit
+    chunks = int(os.environ.get(
+        "BENCH_CHUNKS", str(max(1, C // 262144)) if on_accel else "1"
+    ))
     cfg = RaftConfig(pre_vote=True, check_quorum=True,
                      unroll_messages=unroll, max_inflight=min(4, W),
-                     inbox_bound=bound, coalesce_commit_refresh=True)
+                     inbox_bound=bound, coalesce_commit_refresh=True,
+                     fleet_chunks=chunks)
     M, E = spec.M, spec.E
 
     devs = jax.devices()

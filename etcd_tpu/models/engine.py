@@ -136,7 +136,7 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
     inner = jax.vmap(node_fn, in_axes=-1, out_axes=-1)
     vmapped = jax.vmap(inner, in_axes=(0, 2, 0, 0, 0, 0, 0, 0))
 
-    def round_fn(
+    def _core(
         state: NodeState,
         inbox: Msg,
         prop_len,
@@ -163,6 +163,40 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
         if with_drop_count:
             dropped = emitted - (next_inbox.type != 0).sum()
             return state, next_inbox, dropped
+        return state, next_inbox
+
+    if cfg.fleet_chunks <= 1:
+        return _core
+
+    def round_fn(*args):
+        # sequential chunking over the (trailing, independent) clusters
+        # axis: bounds peak HLO-temp memory at 1/chunks while the whole
+        # fleet stays resident (see RaftConfig.fleet_chunks). The gate
+        # threads a scalar dependency through an optimization_barrier so
+        # XLA cannot schedule two chunks' temp sets concurrently.
+        C = args[0].term.shape[-1]
+        chunks = cfg.fleet_chunks
+        if C % chunks:
+            return _core(*args)
+        csz = C // chunks
+        outs = []
+        gate = jnp.int32(0)
+        for i in range(chunks):
+            a_i = jax.tree.map(
+                lambda x: jax.lax.dynamic_slice_in_dim(x, i * csz, csz, -1),
+                args,
+            )
+            a_i, gate = jax.lax.optimization_barrier((a_i, gate))
+            out = _core(*a_i)
+            gate = out[0].term[0, 0].astype(jnp.int32)
+            outs.append(out)
+        def cat(*xs):
+            return jnp.concatenate(xs, axis=-1)
+
+        state = jax.tree.map(cat, *[o[0] for o in outs])
+        next_inbox = jax.tree.map(cat, *[o[1] for o in outs])
+        if with_drop_count:
+            return state, next_inbox, sum(o[2] for o in outs)
         return state, next_inbox
 
     return round_fn

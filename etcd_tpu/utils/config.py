@@ -61,6 +61,15 @@ class RaftConfig:
     # drop contract; the end-of-round flush preserves commit liveness.
     # Off for the golden/test paths (exact reference message schedule).
     coalesce_commit_refresh: bool = False
+    # Process the fleet's clusters axis in this many sequential chunks per
+    # round (clusters are independent, so per-cluster math is unchanged).
+    # The round program's HLO temps scale with the resident C, so chunking
+    # bounds peak HBM while the full fleet state stays device-resident —
+    # how one chip holds the 1M-group configuration (SCALE_RESULTS.jsonl).
+    # Single-device only: slicing a sharded trailing axis would force
+    # cross-device traffic (the 8-chip mesh holds 131k/chip and needs no
+    # chunking). 1 disables.
+    fleet_chunks: int = 1
 
     def __post_init__(self):
         if self.heartbeat_tick <= 0:

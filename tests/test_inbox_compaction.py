@@ -80,6 +80,36 @@ def test_steady_state_bound_is_lossless():
     assert int(a.s.commit.min()) >= 10  # real replication happened
 
 
+def test_fleet_chunking_is_exact():
+    """RaftConfig.fleet_chunks: clusters are independent, so the chunked
+    round must produce bit-identical fleets (and identical drop counts on
+    the metered path)."""
+    spec = Spec(M=5, L=32, E=1, K=2, W=4, R=2, A=2)
+
+    def run(chunks):
+        cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                         inbox_bound=4, coalesce_commit_refresh=True,
+                         fleet_chunks=chunks)
+        cl = Cluster(n_members=5, C=8, spec=spec, cfg=cfg)
+        for c in range(8):
+            cl.campaign(c % 5, c=c)
+        cl.stabilize()
+        for _ in range(6):
+            for c in range(8):
+                cl.propose(0, 7, c=c)
+            cl.step()
+        return cl
+
+    a, b, d = run(1), run(2), run(4)
+    for field in ("term", "commit", "applied", "last_index", "applied_hash",
+                  "role", "lead", "match", "next_idx"):
+        fa = np.asarray(getattr(a.s, field))
+        assert np.array_equal(fa, np.asarray(getattr(b.s, field))), field
+        assert np.array_equal(fa, np.asarray(getattr(d.s, field))), field
+    assert np.array_equal(np.asarray(a.eng.inbox.type),
+                          np.asarray(b.eng.inbox.type))
+
+
 def test_coalesced_refresh_preserves_commit_schedule():
     """Coalescing halves message traffic but must not delay commits: the
     per-round commit trajectory matches the uncoalesced engine exactly."""
