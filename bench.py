@@ -54,8 +54,10 @@ def main() -> None:
     # pads only the tiny member axes (<=1.6x) and C can grow toward the 1M
     # north-star without tile-padding blowup.
     # defaults match the measured configuration (SCALE_RESULTS.jsonl) so
-    # a cold driver run reuses the persisted compile for the same shapes
-    C = int(os.environ.get("BENCH_C", 262144 if on_accel else 512))
+    # a cold driver run reuses the persisted compile for the same shapes —
+    # the north-star 1M-group fleet, resident on one chip via 8-way fleet
+    # chunking + the int16 wire (434k group-rounds/s measured)
+    C = int(os.environ.get("BENCH_C", 1048576 if on_accel else 512))
     inner = int(os.environ.get("BENCH_ROUNDS", 16 if on_accel else 8))
     reps = int(os.environ.get("BENCH_REPS", 3 if on_accel else 2))
 
@@ -86,15 +88,18 @@ def main() -> None:
     # message loop from M*K+3 to bound+3 steps per round.
     bound = int(os.environ.get("BENCH_INBOX", str(spec.M - 1)))
     # fleet chunking caps peak HLO-temp HBM (RaftConfig.fleet_chunks):
-    # default keeps each resident chunk at <= 262,144 clusters, the
-    # largest single-chunk configuration measured to fit
+    # default keeps each resident chunk at <= 131,072 clusters — the
+    # configuration the measured 1M run used (8 chunks)
     chunks = int(os.environ.get(
-        "BENCH_CHUNKS", str(max(1, C // 262144)) if on_accel else "1"
+        "BENCH_CHUNKS", str(max(1, C // 131072)) if on_accel else "1"
     ))
+    # wire_int16 halves the resident inbox (legal at bench horizons: every
+    # wire value stays far below 32768 — see RaftConfig.wire_int16)
+    wire16 = os.environ.get("BENCH_WIRE16", "1" if on_accel else "0") != "0"
     cfg = RaftConfig(pre_vote=True, check_quorum=True,
                      unroll_messages=unroll, max_inflight=min(4, W),
                      inbox_bound=bound, coalesce_commit_refresh=True,
-                     fleet_chunks=chunks)
+                     fleet_chunks=chunks, wire_int16=wire16)
     M, E = spec.M, spec.E
 
     devs = jax.devices()
@@ -103,7 +108,7 @@ def main() -> None:
     # device (clusters-minor) layout: [M, C] scalars, [M, E, C] proposals,
     # [M(from), M(to), C] keep-mask
     state = init_fleet(spec, C, seed=0, election_tick=cfg.election_tick)
-    inbox = empty_inbox(spec, C)
+    inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
     keep = jnp.ones((M, M, C), jnp.bool_)
     z2 = jnp.zeros((M, C), jnp.int32)
     zp = jnp.zeros((M, E, C), jnp.int32)

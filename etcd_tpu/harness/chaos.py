@@ -188,7 +188,7 @@ def run_chaos(
     a leader and fresh commits. Returns the violation counts + liveness
     stats; raises nothing (the caller asserts)."""
     state = init_fleet(spec, C, election_tick=cfg.election_tick, seed=seed)
-    inbox = empty_inbox(spec, C)
+    inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
     held = jax.tree.map(jnp.zeros_like, inbox)
     key = jax.random.PRNGKey(seed)
     M = spec.M

@@ -57,7 +57,20 @@ def _flatten_inbox(spec: Spec, msgs: Msg) -> Msg:
     return Msg(**{k: f(k, getattr(msgs, k)) for k in Msg.__dataclass_fields__})
 
 
-def empty_inbox(spec: Spec, C: int) -> Msg:
+def to_wire(m: Msg) -> Msg:
+    """int32 -> int16 at the round boundary (RaftConfig.wire_int16)."""
+    return jax.tree.map(
+        lambda x: x.astype(jnp.int16) if x.dtype == jnp.int32 else x, m
+    )
+
+
+def from_wire(m: Msg) -> Msg:
+    return jax.tree.map(
+        lambda x: x.astype(jnp.int32) if x.dtype == jnp.int16 else x, m
+    )
+
+
+def empty_inbox(spec: Spec, C: int, wire_int16: bool = False) -> Msg:
     """Zeroed inbox, stored FLAT: leaves [from, K*to, C] (ent fields
     [from, K*to*E, C]).
 
@@ -74,7 +87,10 @@ def empty_inbox(spec: Spec, C: int) -> Msg:
 
     def mk(name, x):
         n = spec.K * spec.M * (spec.E if name in _ENT_FIELDS else 1)
-        return jnp.zeros((spec.M, n, C), x.dtype)
+        dt = x.dtype
+        if wire_int16 and dt == jnp.int32:
+            dt = jnp.int16
+        return jnp.zeros((spec.M, n, C), dt)
 
     return Msg(**{k: mk(k, getattr(m, k)) for k in Msg.__dataclass_fields__})
 
@@ -147,6 +163,8 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
         do_tick,
         keep_mask,
     ):
+        if cfg.wire_int16:
+            inbox = from_wire(inbox)
         inbox5 = _unflatten_inbox(spec, inbox)  # free reshape
         state, ob = vmapped(
             state, inbox5, prop_len, prop_data, prop_type, ri_ctx, do_hup,
@@ -160,6 +178,8 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
         emitted = (msgs.type != 0).sum() if with_drop_count else None
         msgs = msgs.replace(type=jnp.where(keep[:, None, :, :], msgs.type, 0))
         next_inbox = _flatten_inbox(spec, msgs)  # flat storage form
+        if cfg.wire_int16:
+            next_inbox = to_wire(next_inbox)
         if with_drop_count:
             dropped = emitted - (next_inbox.type != 0).sum()
             return state, next_inbox, dropped
@@ -169,35 +189,59 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
         return _core
 
     def round_fn(*args):
-        # sequential chunking over the (trailing, independent) clusters
-        # axis: bounds peak HLO-temp memory at 1/chunks while the whole
-        # fleet stays resident (see RaftConfig.fleet_chunks). The gate
-        # threads a scalar dependency through an optimization_barrier so
-        # XLA cannot schedule two chunks' temp sets concurrently.
+        # Sequential chunking over the (trailing, independent) clusters
+        # axis: bounds peak HLO-temp memory at ~1/chunks while the whole
+        # fleet stays resident (see RaftConfig.fleet_chunks). Results are
+        # written back with dynamic_update_slice on the carried state/inbox
+        # values — the in-place idiom XLA aliases inside loop carries and
+        # donated calls, so the fleet is single-buffered (a concatenate
+        # stitch materialized a second full fleet and re-OOMed at 1M).
+        # The chunk sweep is a fori_loop whose carry IS the fleet, updated
+        # by dynamic_update_slice — the canonical XLA in-place loop-carry
+        # idiom (KV-cache-style), so the fleet stays single-buffered while
+        # only one chunk's temps are ever live. (A Python-level chunk loop
+        # was tried first: with optimization_barrier sequencing, the
+        # barrier's lowering defeated donation aliasing; without it, the
+        # scheduler overlapped chunk temp sets. Both re-OOMed at 1M.)
+        # Chunk i+1 slices from the updated carry: its region is untouched
+        # by earlier writes, so per-cluster math is unchanged.
         C = args[0].term.shape[-1]
         chunks = cfg.fleet_chunks
         if C % chunks:
             return _core(*args)
         csz = C // chunks
-        outs = []
-        gate = jnp.int32(0)
-        for i in range(chunks):
-            a_i = jax.tree.map(
-                lambda x: jax.lax.dynamic_slice_in_dim(x, i * csz, csz, -1),
-                args,
-            )
-            a_i, gate = jax.lax.optimization_barrier((a_i, gate))
-            out = _core(*a_i)
-            gate = out[0].term[0, 0].astype(jnp.int32)
-            outs.append(out)
-        def cat(*xs):
-            return jnp.concatenate(xs, axis=-1)
+        rest = args[2:]
 
-        state = jax.tree.map(cat, *[o[0] for o in outs])
-        next_inbox = jax.tree.map(cat, *[o[1] for o in outs])
+        def body(i, carry):
+            state, inbox, dropped = carry
+            start = i * csz
+
+            def sl(x):
+                return jax.lax.dynamic_slice_in_dim(x, start, csz, -1)
+
+            a_i = (
+                jax.tree.map(sl, state),
+                jax.tree.map(sl, inbox),
+            ) + tuple(jax.tree.map(sl, r) for r in rest)
+            out = _core(*a_i)
+
+            def wr(full, part):
+                return jax.lax.dynamic_update_slice_in_dim(
+                    full, part, start, -1
+                )
+
+            state = jax.tree.map(wr, state, out[0])
+            inbox = jax.tree.map(wr, inbox, out[1])
+            if with_drop_count:
+                dropped = dropped + out[2]
+            return (state, inbox, dropped)
+
+        state, inbox, dropped = jax.lax.fori_loop(
+            0, chunks, body, (args[0], args[1], jnp.int32(0))
+        )
         if with_drop_count:
-            return state, next_inbox, sum(o[2] for o in outs)
-        return state, next_inbox
+            return state, inbox, dropped
+        return state, inbox
 
     return round_fn
 
@@ -218,7 +262,7 @@ class RaftEngine:
         self.state = init_fleet(
             spec, C, voters, learners, seed, election_tick=cfg.election_tick
         )
-        self.inbox = empty_inbox(spec, C)
+        self.inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
         self.keep_mask = jnp.ones((spec.M, spec.M, C), jnp.bool_)
         self._round = jax.jit(build_round(cfg, spec))
 

@@ -70,6 +70,15 @@ class RaftConfig:
     # cross-device traffic (the 8-chip mesh holds 131k/chip and needs no
     # chunking). 1 disables.
     fleet_chunks: int = 1
+    # Store the carried inter-round message tensor (the "wire") as int16
+    # instead of int32: halves the resident inbox, which at the 1M-group
+    # configuration is the largest single fleet buffer. Casts happen at
+    # the round boundary; all round math stays int32. SCALE MODE ONLY:
+    # every wire-carried value (terms, log indexes, commit indexes,
+    # payload words, read contexts) must stay below 32768 — true for
+    # bench/chaos horizons (hundreds of rounds, small payload alphabet),
+    # NOT for long-lived servers whose payload words grow unboundedly.
+    wire_int16: bool = False
 
     def __post_init__(self):
         if self.heartbeat_tick <= 0:

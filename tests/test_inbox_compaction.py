@@ -110,6 +110,35 @@ def test_fleet_chunking_is_exact():
                           np.asarray(b.eng.inbox.type))
 
 
+def test_wire_int16_is_exact_at_small_horizon():
+    """RaftConfig.wire_int16: at horizons where every wire value fits
+    int16 (the scale-mode contract), the i16 wire reproduces the i32
+    trajectories bit-for-bit."""
+    spec = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+
+    def run(wire16):
+        cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                         inbox_bound=4, coalesce_commit_refresh=True,
+                         wire_int16=wire16)
+        cl = Cluster(n_members=5, C=4, spec=spec, cfg=cfg)
+        for c in range(4):
+            cl.campaign(c % 5, c=c)
+        cl.stabilize()
+        for r in range(8):
+            for c in range(4):
+                cl.propose(0, 100 + r, c=c)
+            cl.step()
+        return cl
+
+    a, b = run(False), run(True)
+    assert b.eng.inbox.term.dtype == jnp.int16
+    for field in ("term", "commit", "applied", "last_index", "applied_hash",
+                  "role", "lead", "match", "next_idx", "log_data"):
+        assert np.array_equal(
+            np.asarray(getattr(a.s, field)), np.asarray(getattr(b.s, field))
+        ), field
+
+
 def test_coalesced_refresh_preserves_commit_schedule():
     """Coalescing halves message traffic but must not delay commits: the
     per-round commit trajectory matches the uncoalesced engine exactly."""
