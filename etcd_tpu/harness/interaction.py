@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import dataclasses
 
+import numpy as np
+
 from etcd_tpu.models import confchange as ccmod
 from etcd_tpu.models.rawnode import (
     PR_NAMES,
@@ -64,6 +66,8 @@ from etcd_tpu.types import (
     MSG_UNREACHABLE,
     MSG_VOTE,
     MSG_VOTE_RESP,
+    PR_REPLICATE,
+    PR_SNAPSHOT,
     ROLE_CANDIDATE,
     ROLE_FOLLOWER,
     ROLE_LEADER,
@@ -148,6 +152,32 @@ class _StateSnap:
     vote: int
     snap_index: int
     conf: tuple
+    commit: int
+    applied: int
+    last_index: int
+    stored_last: int  # storage.LastIndex(): unstable.offset - 1
+
+
+# Go value-rendering of ConfChangeV2 (raftpb confchange String forms), used
+# by the leader's "ignoring conf change" refusal line (raft.go:1034-1071).
+_CC_GO_NAMES = {
+    CC_ADD_NODE: "ConfChangeAddNode",
+    CC_REMOVE_NODE: "ConfChangeRemoveNode",
+    CC_UPDATE_NODE: "ConfChangeUpdateNode",
+    CC_ADD_LEARNER: "ConfChangeAddLearnerNode",
+}
+_TRANSITION_GO = {
+    "auto": "ConfChangeTransitionAuto",
+    "implicit": "ConfChangeTransitionJointImplicit",
+    "explicit": "ConfChangeTransitionJointExplicit",
+}
+
+
+def cc_go_str(changes, transition: str) -> str:
+    chs = " ".join(
+        "{%s %d}" % (_CC_GO_NAMES[t], nid + 1) for t, nid in changes
+    )
+    return "{%s [%s] []}" % (_TRANSITION_GO[transition], chs)
 
 
 class InteractionEnv:
@@ -171,6 +201,9 @@ class InteractionEnv:
         self.messages: list[HostMsg] = []
         self.payloads = PayloadTable()
         self.v1_words: set[int] = set()
+        # per-node vote tally for the current campaign: (granted, rejected)
+        # voter-id sets (the poll() bookkeeping, raft.go:837-845)
+        self._votes: dict[int, tuple[set, set]] = {}
         self.lvl = LVL_DEBUG
         self._lines: list[str] = []
         self._indent = 0
@@ -272,7 +305,30 @@ class InteractionEnv:
             term=int(n.term), role=int(n.role), lead=int(n.lead),
             vote=int(n.vote), snap_index=int(n.snap_index),
             conf=rn._conf_tuple(),
+            commit=int(n.commit), applied=int(n.applied),
+            last_index=int(n.last_index),
+            stored_last=self.storages[idx].last_index(),
         )
+
+    def _last_log(self, idx: int) -> tuple[int, int]:
+        """(lastTerm, lastIndex) of a node's log."""
+        n = self.nodes[idx].n
+        li = int(n.last_index)
+        if li == int(n.snap_index):
+            return int(n.snap_term), li
+        return int(n.log_term[(li - 1) % self.spec.L]), li
+
+    def _term_at(self, idx: int, i: int) -> int:
+        """zeroTermOnOutOfBounds(term(i)) (raft log.go)."""
+        n = self.nodes[idx].n
+        if i == int(n.snap_index):
+            return int(n.snap_term)
+        if int(n.snap_index) < i <= int(n.last_index):
+            return int(n.log_term[(i - 1) % self.spec.L])
+        return 0
+
+    def _progress_of(self, idx: int, pid: int):
+        return self.nodes[idx].status().progress.get(pid)
 
     def _emit_transitions(self, idx: int, before: _StateSnap,
                           trigger: HostMsg | None = None) -> None:
@@ -294,6 +350,20 @@ class InteractionEnv:
         restored = int(n.snap_index) > before.snap_index and (
             trigger is not None and trigger.type == MSG_SNAP
         )
+        if restored:
+            # raftLog.restore preamble (raft/log.go:86-90): unstable.offset
+            # is one past the last persisted entry; everything this harness
+            # appends is persisted at the next Ready, so offset derives from
+            # the storage's last index at delivery time.
+            self.log(
+                LVL_INFO,
+                f"log [committed={before.commit}, applied={before.applied}, "
+                f"unstable.offset={before.stored_last + 1}, "
+                f"len(unstable.Entries)="
+                f"{before.last_index - before.stored_last}] starts to "
+                f"restore snapshot [index: {int(n.snap_index)}, "
+                f"term: {int(n.snap_term)}]",
+            )
         if restored and rn._conf_tuple() != before.conf:
             self.log(
                 LVL_INFO,
@@ -374,6 +444,7 @@ class InteractionEnv:
             LVL_INFO,
             f"{rid} is starting a new election at term {before.term}",
         )
+        self._votes[idx] = ({idx}, set())
         rn.campaign()
         n = rn.n
         role, term = int(n.role), int(n.term)
@@ -428,6 +499,31 @@ class InteractionEnv:
                 "v1 conf change can only have one operation and no transition"
             )
             return
+        rn = self.nodes[idx]
+        if int(rn.n.role) == ROLE_LEADER:
+            # the appendEntry guard (raft.go:1034-1071): the leader demotes
+            # a refused conf change to an empty entry and says why
+            cs = rn.conf_state()
+            joint = bool(cs.voters_outgoing)
+            wants_leave = not changes
+            pci, applied = int(rn.n.pending_conf_index), int(rn.n.applied)
+            reason = None
+            if pci > applied:
+                reason = (
+                    f"possible unapplied conf change at index {pci} "
+                    f"(applied to {applied})"
+                )
+            elif joint and not wants_leave:
+                reason = "must transition out of joint config first"
+            elif not joint and wants_leave:
+                reason = "not in joint state; refusing empty conf change"
+            if reason:
+                self.log(
+                    LVL_INFO,
+                    f"{self.r(idx)} ignoring conf change "
+                    f"{cc_go_str(changes, transition)} at config "
+                    f"{conf_str(cs)}: {reason}",
+                )
         if not changes and transition == "auto":
             word = ccmod.encode_leave_joint()
         else:
@@ -474,13 +570,224 @@ class InteractionEnv:
                         ),
                     )
                     break
+        rn = self.nodes[idx]
         before = self._snap_state(idx)
+        msgs0 = len(rn._pending_msgs)
+        # pre-step observations for the logger lines only derivable from
+        # state the step overwrites
+        lead_resp = (
+            m.type in (MSG_APP_RESP, MSG_HEARTBEAT_RESP)
+            and before.role == ROLE_LEADER
+        )
+        pre_prog = self._progress_of(idx, m.frm) if lead_resp else None
+        pre_terms = (
+            np.asarray(rn.n.log_term)
+            if m.type == MSG_APP and m.entries else None
+        )
         try:
-            self.nodes[idx].step(m)
+            rn.step(m)
         except (ErrStepLocalMsg, ErrStepPeerNotFound) as e:
             self.p(str(e))
             return
+        delta = rn._pending_msgs[msgs0:]
+        self._emit_vote_tally(idx, before, m)
+        # becomeCandidate/becomePreCandidate reset the poll bookkeeping;
+        # a step-triggered candidacy (pre-vote won, MsgTimeoutNow) must
+        # reset it here too, after the triggering response was tallied
+        role_now = int(rn.n.role)
+        stepped_into_candidacy = role_now in (
+            ROLE_CANDIDATE, ROLE_PRE_CANDIDATE
+        ) and role_now != before.role
+        if stepped_into_candidacy:
+            self._votes[idx] = ({idx}, set())
         self._emit_transitions(idx, before, trigger=m)
+        if stepped_into_candidacy:
+            # campaign() ran inside this step (pre-vote won, MsgTimeoutNow):
+            # Go logs the self-vote poll and the vote-request sends too
+            self._emit_campaign_lines(idx, before, msgs0)
+        self._emit_post_step(idx, before, m, delta, pre_prog, pre_terms)
+
+    def _emit_vote_tally(self, idx: int, before: _StateSnap,
+                         m: HostMsg) -> None:
+        """poll() receipt + tally (raft.go:837-845, stepCandidate) — logged
+        before any role transition the response triggers."""
+        if (
+            m.type not in (MSG_VOTE_RESP, MSG_PRE_VOTE_RESP)
+            or before.role not in (ROLE_CANDIDATE, ROLE_PRE_CANDIDATE)
+            or m.term < before.term  # stale responses are ignored outright
+        ):
+            return
+        # a response at a higher term dethrones the candidate instead of
+        # being polled — EXCEPT a granted pre-vote response, which echoes
+        # the candidate's future term (raft.go Step's MsgPreVoteResp carve-
+        # out) and is the normal pre-vote grant
+        if m.term > before.term and not (
+            m.type == MSG_PRE_VOTE_RESP and not m.reject
+        ):
+            return
+        gr, rj = self._votes.setdefault(idx, (set(), set()))
+        (rj if m.reject else gr).add(m.frm)
+        rid = self.r(idx)
+        name = MSG_NAMES[m.type]
+        if m.reject:
+            self.log(
+                LVL_INFO,
+                f"{rid} received {name} rejection from {self.r(m.frm)} "
+                f"at term {before.term}",
+            )
+        else:
+            self.log(
+                LVL_INFO,
+                f"{rid} received {name} from {self.r(m.frm)} "
+                f"at term {before.term}",
+            )
+        self.log(
+            LVL_INFO,
+            f"{rid} has received {len(gr)} {name} votes and "
+            f"{len(rj)} vote rejections",
+        )
+
+    def _emit_post_step(self, idx: int, before: _StateSnap, m: HostMsg,
+                        delta: list[HostMsg], pre_prog,
+                        pre_terms) -> None:
+        """Logger lines derived from what the step did: vote casting,
+        append rejection/conflict, and the leader's probe/snapshot
+        bookkeeping (raft.go stepLeader / handleAppendEntries)."""
+        rn = self.nodes[idx]
+        n = rn.n
+        rid = self.r(idx)
+        if m.type in (MSG_VOTE, MSG_PRE_VOTE):
+            resp = next(
+                (p for p in delta
+                 if p.type in (MSG_VOTE_RESP, MSG_PRE_VOTE_RESP)), None
+            )
+            if resp is None:
+                return
+            lt, li = self._last_log(idx)
+            # r.Vote at log time: reset by a real-vote term bump; a
+            # pre-vote never changes term or vote, so the recorded vote
+            # still shows
+            shown = (
+                0 if m.term > before.term and m.type == MSG_VOTE
+                else self.r(before.vote)
+            )
+            verb = (
+                f"rejected {MSG_NAMES[m.type]} from"
+                if resp.reject else f"cast {MSG_NAMES[m.type]} for"
+            )
+            self.log(
+                LVL_INFO,
+                f"{rid} [logterm: {lt}, index: {li}, vote: {shown}] {verb} "
+                f"{self.r(m.frm)} [logterm: {m.log_term}, "
+                f"index: {m.index}] at term {int(n.term)}",
+            )
+        elif m.type == MSG_APP:
+            reject = next(
+                (p for p in delta if p.type == MSG_APP_RESP and p.reject),
+                None,
+            )
+            if reject is not None:
+                # handleAppendEntries rejection (raft.go:1633-1668); the
+                # log is untouched, so the post-step term lookup is the
+                # pre-step one
+                self.log(
+                    LVL_DEBUG,
+                    f"{rid} [logterm: {self._term_at(idx, m.index)}, "
+                    f"index: {m.index}] rejected MsgApp "
+                    f"[logterm: {m.log_term}, index: {m.index}] "
+                    f"from {self.r(m.frm)}",
+                )
+            elif pre_terms is not None:
+                # findConflict + truncateAndAppend (raft/log.go:118-151):
+                # first overlapping entry whose stored term differs
+                for e in m.entries:
+                    if e.index > before.last_index:
+                        break
+                    if e.index <= before.snap_index:
+                        continue
+                    ext = int(pre_terms[(e.index - 1) % self.spec.L])
+                    if ext != e.term:
+                        self.log(
+                            LVL_INFO,
+                            f"found conflict at index {e.index} [existing "
+                            f"term: {ext}, conflicting term: {e.term}]",
+                        )
+                        self.log(
+                            LVL_INFO,
+                            f"replace the unstable entries from index "
+                            f"{e.index}",
+                        )
+                        break
+        elif pre_prog is not None:
+            # one post-step Status serves the response lookup and every
+            # snapshot the step emitted
+            post_progs = rn.status().progress
+            post = post_progs.get(m.frm)
+            if m.type == MSG_APP_RESP and m.reject:
+                self.log(
+                    LVL_DEBUG,
+                    f"{rid} received MsgAppResp(rejected, hint: (index "
+                    f"{m.reject_hint}, term {m.log_term})) from "
+                    f"{self.r(m.frm)} for index {m.index}",
+                )
+                if post is not None and (
+                    (post.match, post.next) != (pre_prog.match, pre_prog.next)
+                    or post.state != pre_prog.state
+                ):
+                    # MaybeDecrTo succeeded. The reference prints the
+                    # progress between the decrease and the BecomeProbe/
+                    # snapshot transition the same step performs: a
+                    # replicating peer still shows StateReplicate with
+                    # next=match+1 (tracker MaybeDecrTo's replicate arm);
+                    # a probing one shows the new next, unchanged by the
+                    # later transition.
+                    if pre_prog.state == PR_REPLICATE:
+                        shown = (
+                            f"StateReplicate match={pre_prog.match} "
+                            f"next={pre_prog.match + 1}"
+                        )
+                    else:
+                        shown = (
+                            f"StateProbe match={post.match} "
+                            f"next={post.next}"
+                        )
+                    self.log(
+                        LVL_DEBUG,
+                        f"{rid} decreased progress of {self.r(m.frm)} to "
+                        f"[{shown}]",
+                    )
+            elif (
+                m.type == MSG_APP_RESP
+                and pre_prog.state == PR_SNAPSHOT
+                and post is not None
+                and post.state != PR_SNAPSHOT
+            ):
+                nxt = max(pre_prog.next, m.index + 1)
+                self.log(
+                    LVL_DEBUG,
+                    f"{rid} recovered from needing snapshot, resumed "
+                    f"sending replication messages to {self.r(m.frm)} "
+                    f"[StateSnapshot match={m.index} next={nxt} paused "
+                    f"pendingSnap={pre_prog.pending_snapshot}]",
+                )
+            for pm in delta:
+                if pm.type != MSG_SNAP or pm.snapshot is None:
+                    continue
+                p = post_progs.get(pm.to)
+                meta = pm.snapshot.meta
+                self.log(
+                    LVL_DEBUG,
+                    f"{rid} [firstindex: {int(n.snap_index) + 1}, "
+                    f"commit: {int(n.commit)}] sent snapshot"
+                    f"[index: {meta.index}, term: {meta.term}] to "
+                    f"{self.r(pm.to)} [StateProbe match={p.match} "
+                    f"next={p.next}]",
+                )
+                self.log(
+                    LVL_DEBUG,
+                    f"{rid} paused sending replication messages to "
+                    f"{self.r(pm.to)} [{p}]",
+                )
 
     def process_ready(self, idx: int) -> None:
         """interaction_env_handler_process_ready.go:40-102."""
@@ -500,6 +807,18 @@ class InteractionEnv:
                 LVL_INFO,
                 f"{self.r(idx)} switched to configuration {conf_str(cs)}",
             )
+            if (
+                cs.voters_outgoing and cs.auto_leave
+                and int(rn.n.role) == ROLE_LEADER
+            ):
+                # the leader schedules the empty leave-joint entry the
+                # moment it applies an auto-leave joint config
+                # (raft.go:668-692)
+                self.log(
+                    LVL_INFO,
+                    "initiating automatic transition out of joint "
+                    f"configuration {conf_str(cs)}",
+                )
         # the "appender state machine" history (process_ready.go:64-90)
         hist = self.history[idx]
         for e in rd.committed_entries:

@@ -2,12 +2,14 @@
 the TPU engine through the InteractionEnv command language
 (raft/rafttest/interaction_env_handler.go:29-146, interaction_test.go:34).
 
-Comparison is semantic: structural output (Ready blocks, message lines,
-entries, status, raft-log) is compared verbatim; logger lines are reduced
-to a curated event vocabulary (role transitions, configuration switches,
-snapshot restores, newRaft boots) that both sides must produce in the
-same order, while incidental Go-logger prose (vote tallies, probe/pause
-DEBUG chatter) is dropped from both sides identically.
+Comparison is EXACT: every line — structural output (Ready blocks,
+message lines, entries, status, raft-log) AND every logger line (role
+transitions, vote casting/tallies, append rejections, log-conflict
+resolution, probe/snapshot pause-resume bookkeeping, joint-config
+transitions) — must match the golden verbatim, modulo whitespace runs
+and one deliberate equivalence: bare "ok" and "ok (quiet)"
+acknowledgement lines both normalize away, since they differ only in
+whether a suppressed-logger line existed while output was off.
 """
 from __future__ import annotations
 
@@ -32,41 +34,17 @@ GOLDENS = [
     "snapshot_succeed_via_app_resp.txt",
 ]
 
-_LOG_TOKENS = ("INFO", "DEBUG", "WARN", "ERROR", "FATAL")
 
-# Curated logger events: both sides must agree on these exactly.
-_CURATED = [
-    ("become", re.compile(
-        r"(?:INFO|DEBUG) (\d+) became "
-        r"(follower|pre-candidate|candidate|leader) at term (\d+)$")),
-    ("switch", re.compile(
-        r"(?:INFO|DEBUG) (\d+) switched to configuration (.+)$")),
-    ("newraft", re.compile(r"(?:INFO|DEBUG) newRaft (\d+) \[(.+)\]$")),
-    ("restored", re.compile(
-        r"(?:INFO|DEBUG) (\d+) \[(.+)\] restored snapshot \[(.+)\]$")),
-]
-
-
-def normalize(text: str) -> list[tuple]:
-    events: list[tuple] = []
+def normalize(text: str) -> list[str]:
+    lines: list[str] = []
     for raw in text.split("\n"):
         line = raw.strip()
-        if not line:
+        if not line or line in ("ok", "ok (quiet)"):
+            # bare acknowledgements carry no semantic content; the quiet
+            # variants differ only in whether any suppressed line existed
             continue
-        if line.split(" ", 1)[0] in _LOG_TOKENS:
-            for kind, rx in _CURATED:
-                m = rx.match(line)
-                if m:
-                    events.append((kind,) + m.groups())
-                    break
-            continue
-        if line in ("ok", "ok (quiet)"):
-            # bare acknowledgements carry no semantic content: a golden
-            # block holding only non-curated logger prose normalizes to
-            # the same empty event list as our "ok"
-            continue
-        events.append(("line", re.sub(r"\s+", " ", line)))
-    return events
+        lines.append(re.sub(r"\s+", " ", line))
+    return lines
 
 
 @pytest.mark.skipif(not reference_available(), reason="no reference checkout")
