@@ -39,17 +39,19 @@ def main() -> int:
     C = int(os.environ.get("CHAOS_C", 262_144 if on_accel else 1_000))
     rounds = int(os.environ.get("CHAOS_ROUNDS", 200))
 
-    # bench geometry (bench.py Spec) so the chaos tier proves the measured
-    # configuration safe under faults — K=2 slots suffice because drops
-    # are legal and counted; L=32 keeps slack for fault-delayed applies.
-    # CHAOS_BOUND trims the serial message loop like BENCH_INBOX; 8 covers
-    # every non-self inbox slot (K*(M-1)), so nothing a fault didn't
-    # already drop is lost.
-    L = int(os.environ.get("CHAOS_L", "32"))
+    # bench geometry (bench.py Spec + RaftConfig) so the chaos tier proves
+    # the MEASURED headline configuration safe under faults: K=2 slots,
+    # L=16 ring, int16 wire, inbox_bound=M-1. Bounded-inbox compaction and
+    # the int16 wire are legal under chaos for the same reason they are in
+    # steady state — anything the bound evicts is a droppable message (the
+    # transport contract already drops via keep-masks), and it is counted.
+    L = int(os.environ.get("CHAOS_L", "16"))
     spec = Spec(M=5, L=L, E=1, K=2, W=4, R=2, A=2)
-    bound = int(os.environ.get("CHAOS_BOUND", str(spec.K * (spec.M - 1))))
+    bound = int(os.environ.get("CHAOS_BOUND", str(spec.M - 1)))
+    wire16 = os.environ.get("CHAOS_WIRE16", "1") != "0"
     cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
-                     inbox_bound=bound, coalesce_commit_refresh=True)
+                     inbox_bound=bound, coalesce_commit_refresh=True,
+                     wire_int16=wire16)
 
     t0 = time.perf_counter()
     rep = run_chaos(

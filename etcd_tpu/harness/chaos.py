@@ -72,33 +72,37 @@ def check_invariants(state: NodeState, prev_commit: jnp.ndarray,
     )
 
 
+def _bc(spec: Spec, mask, leaf):
+    """Broadcast a [from, K*to, C] slot mask to a leaf's shape (ent leaves
+    repeat the middle axis per entry — the engine's FLAT storage form)."""
+    if leaf.shape[1] != mask.shape[1]:
+        return jnp.repeat(mask, spec.E, axis=1)
+    return mask
+
+
+def _held_wins(spec: Spec, held: Msg, fresh: Msg) -> Msg:
+    """Merge a held-message buffer over fresh traffic: a held message wins
+    a slot collision (the fresh one drops — legal per the transport
+    contract, etcdserver/raft.go:107-110). The type leaf merges under the
+    same mask as every other leaf, so liveness follows the values."""
+    live = held.type != 0
+    return jax.tree.map(
+        lambda h, f: jnp.where(_bc(spec, live, h), h, f), held, fresh
+    )
+
+
 def _merge_delayed(spec: Spec, out: Msg, held: Msg,
                    delay_mask) -> tuple[Msg, Msg]:
     """Split this round's traffic by the delay mask and merge in messages
-    held from the previous round. A held message wins a slot collision
-    (the fresh one drops — legal per the transport contract,
-    etcdserver/raft.go:107-110). Message leaves are in the engine's FLAT
+    held from the previous round. Message leaves are in the engine's FLAT
     storage form [from, K*to(*E), C]; `delay_mask` is [from, K*to, C]."""
-
-    def bc(mask, leaf):
-        if leaf.shape[1] != mask.shape[1]:  # ent leaf: repeat per entry
-            return jnp.repeat(mask, spec.E, axis=1)
-        return mask
-
     dm = delay_mask
     new_held = jax.tree.map(
-        lambda x: jnp.where(bc(dm, x), x, jnp.zeros_like(x)), out
+        lambda x: jnp.where(_bc(spec, dm, x), x, jnp.zeros_like(x)), out
     )
     new_held = new_held.replace(type=jnp.where(dm, out.type, 0))
     fresh = out.replace(type=jnp.where(dm, 0, out.type))
-    held_live = held.type != 0
-    merged = jax.tree.map(
-        lambda h, f: jnp.where(bc(held_live, h), h, f), held, fresh
-    )
-    merged = merged.replace(
-        type=jnp.where(held_live, held.type, fresh.type)
-    )
-    return merged, new_held
+    return _held_wins(spec, held, fresh), new_held
 
 
 def build_chaos_epoch(
@@ -123,6 +127,7 @@ def build_chaos_epoch(
     """
     round_fn = build_round(cfg, spec)
     M = spec.M
+    faultless = drop_p == 0.0 and delay_p == 0.0 and partition_p == 0.0
 
     def epoch(state, inbox, held, key, prop_len, prop_data, viol,
               prev_commit):
@@ -133,6 +138,31 @@ def build_chaos_epoch(
         do_tick = jnp.full((M, C), tick, jnp.bool_)
         commit0 = state.commit.sum()
         key, pkey = jax.random.split(key)
+
+        if faultless:
+            # heal program: no fault sampling, no delay bookkeeping. Drain
+            # whatever the previous chaos epoch still held by merging it
+            # into the entry inbox once (held wins a slot collision, as in
+            # _merge_delayed), then run bare rounds with per-round checks.
+            inbox = _held_wins(spec, held, inbox)
+            held = jax.tree.map(jnp.zeros_like, held)
+            keep_all = jnp.ones((M, M, C), jnp.bool_)
+
+            def heal_body(carry, r):
+                state, inbox, viol, prev_commit = carry
+                state, out = round_fn(
+                    state, inbox, prop_len, prop_data, zp, z2, no,
+                    do_tick, keep_all
+                )
+                viol = check_invariants(state, prev_commit, viol)
+                return (state, out, viol, state.commit), None
+
+            (state, inbox, viol, prev_commit), _ = jax.lax.scan(
+                heal_body, (state, inbox, viol, prev_commit),
+                jnp.arange(rounds, dtype=jnp.int32),
+            )
+            return (state, inbox, held, key, viol,
+                    state.commit.sum() - commit0)
 
         def body(carry, r):
             state, inbox, held, key, viol, prev_commit = carry

@@ -376,6 +376,12 @@ def maybe_send_append(
     # interaction_env_handler_add_nodes.go:39-58) and catches the
     # follower up as far as possible in one message.
     t_app, _ = logops.term_at(spec, n, n.applied)
+    # the 32-bit applied hash rides split across commit (low 16 bits,
+    # bit-exact through the int16 wire's truncate/sign-extend round trip)
+    # and reject_hint (arithmetic >>16: a value in [-32768, 32767], exact
+    # in int16) — a whole hash in `commit` alone is silently truncated by
+    # RaftConfig.wire_int16 and corrupts every restored follower's hash
+    # chain (found by the chaos tier's KV_HASH checker)
     snap = bcast(spec, base).replace(
         type=jnp.where(send_snap, MSG_SNAP, MSG_NONE),
         term=jnp.broadcast_to(n.term, (spec.M,)),
@@ -383,6 +389,7 @@ def maybe_send_append(
         index=jnp.broadcast_to(n.applied, (spec.M,)),
         log_term=jnp.broadcast_to(t_app, (spec.M,)),
         commit=jnp.broadcast_to(n.applied_hash, (spec.M,)),
+        reject_hint=jnp.broadcast_to(n.applied_hash >> 16, (spec.M,)),
         reject=jnp.broadcast_to(n.auto_leave, (spec.M,)),
         c_voters=jnp.broadcast_to(pack_mask(n.voters), (spec.M,)),
         c_voters_out=jnp.broadcast_to(pack_mask(n.voters_out), (spec.M,)),
@@ -392,8 +399,9 @@ def maybe_send_append(
         ),
     )
     ob = emit(spec, ob, send_snap, snap,
-              fields=("index", "log_term", "commit", "c_voters",
-                      "c_voters_out", "c_learners", "c_learners_next"))
+              fields=("index", "log_term", "commit", "reject_hint",
+                      "c_voters", "c_voters_out", "c_learners",
+                      "c_learners_next"))
     ob = record_sent_commit(ob, send_snap, n.commit)
     n = n.replace(
         pr_state=jnp.where(send_snap, PR_SNAPSHOT, n.pr_state),
@@ -738,14 +746,19 @@ def handle_snapshot(cfg, spec, n, ob, m: Msg, enable):
 
     n = tree_where(do_fast, logops.commit_to(n, sindex), n)
 
+    # reassemble the split applied hash (see the MsgSnap emit site): low
+    # 16 bits from commit, high 16 from reject_hint — exact under both
+    # the int32 and the int16 wire
+    shash = ((m.reject_hint << 16) | (m.commit & 0xFFFF)).astype(jnp.int32)
+
     restored = n.replace(
         last_index=sindex,
         commit=sindex,
         applied=sindex,
-        applied_hash=m.commit,
+        applied_hash=shash,
         snap_index=sindex,
         snap_term=sterm,
-        snap_hash=m.commit,
+        snap_hash=shash,
         snap_voters=mv,
         snap_voters_out=mvo,
         snap_learners=ml,

@@ -226,7 +226,10 @@ def host_to_device_msg(spec: Spec, hm: HostMsg) -> Msg:
         meta = hm.snapshot.meta
         v, vo, l, ln_ = meta.conf_state.masks(spec.M)
         kw.update(
+            # app_hash split across commit/reject_hint, matching the
+            # device MsgSnap emit (models/raft.py maybe_send_append)
             index=meta.index, log_term=meta.term, commit=meta.app_hash,
+            reject_hint=(meta.app_hash >> 16) & 0xFFFF,
             reject=meta.conf_state.auto_leave,
             c_voters=pack_mask(jnp.asarray(v)),
             c_voters_out=pack_mask(jnp.asarray(vo)),
@@ -289,12 +292,18 @@ def outbox_to_host(spec: Spec, ob: Outbox) -> list[HostMsg]:
                     ub(f["c_learners_next"][k, to]),
                     bool(f["reject"][k, to]),
                 )
+                # reassemble the split app hash (device MsgSnap wire
+                # format, models/raft.py maybe_send_append)
+                raw = (
+                    (int(f["reject_hint"][k, to]) << 16)
+                    | (int(f["commit"][k, to]) & 0xFFFF)
+                ) & 0xFFFFFFFF
                 snap = Snapshot(
                     meta=SnapshotMeta(
                         index=int(f["index"][k, to]),
                         term=int(f["log_term"][k, to]),
                         conf_state=cs,
-                        app_hash=int(f["commit"][k, to]),
+                        app_hash=raw - (1 << 32) if raw >= 1 << 31 else raw,
                     )
                 )
             out.append(
@@ -305,7 +314,8 @@ def outbox_to_host(spec: Spec, ob: Outbox) -> list[HostMsg]:
                     log_term=0 if t == MSG_SNAP else int(f["log_term"][k, to]),
                     commit=0 if t == MSG_SNAP else int(f["commit"][k, to]),
                     reject=False if t == MSG_SNAP else bool(f["reject"][k, to]),
-                    reject_hint=int(f["reject_hint"][k, to]),
+                    reject_hint=0 if t == MSG_SNAP
+                    else int(f["reject_hint"][k, to]),
                     context=int(f["context"][k, to]),
                     entries=ents,
                     snapshot=snap,

@@ -1,0 +1,65 @@
+"""RaftConfig.wire_int16 safety: every value that crosses the int16 wire
+must survive the truncate/sign-extend round trip.
+
+Regression for a chaos-found corruption: MsgSnap carried the 32-bit
+applied hash in the `commit` field, which the int16 wire silently
+truncated — every snapshot-restored follower adopted a wrong hash chain
+and the KV_HASH checker (harness/chaos.py) flagged hash divergence at
+equal applied indexes. The hash now rides split across commit (low 16
+bits) and reject_hint (high 16), exact under both wire widths
+(models/raft.py maybe_send_append / handle_snapshot).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from etcd_tpu.harness.cluster import Cluster
+from etcd_tpu.types import Spec
+from etcd_tpu.utils.config import RaftConfig
+
+SEED_HASH = 0x12345678  # high 16 bits live
+
+
+def _snapshot_catchup(wire16: bool):
+    spec = Spec(M=3, L=8, E=1, K=2, W=4, R=2, A=8)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                     wire_int16=wire16)
+    cl = Cluster(n_members=3, C=1, spec=spec, cfg=cfg)
+    cl.campaign(0)
+    cl.stabilize()
+    assert int(cl.get("role", 0)) == 3
+    for m in range(3):
+        cl.set_node(m, applied_hash=np.int32(SEED_HASH))
+    cl.isolate(2)
+    # push the leader past the follower's reach: > L entries applied and
+    # ring-compacted, so re-joining node 2 needs a snapshot
+    for i in range(12):
+        cl.propose(0, 100 + i)
+        cl.step()
+        cl.step()
+    cl.stabilize()
+    assert int(cl.get("snap_index", 0)) > int(cl.get("last_index", 2)), (
+        "setup failed: leader did not compact past the follower"
+    )
+    cl.recover()
+    # heartbeat ticks re-trigger the paused probe so the leader notices
+    # the follower is back and ships the snapshot
+    cl.stabilize(tick=True)
+    return cl
+
+
+def test_snapshot_hash_survives_int16_wire():
+    cl = _snapshot_catchup(wire16=True)
+    lh, fh = int(cl.get("applied_hash", 0)), int(cl.get("applied_hash", 2))
+    assert (lh >> 16) not in (0, -1), "test vector lost its high bits"
+    assert fh == lh, (
+        f"restored follower hash {fh:#x} != leader hash {lh:#x}: "
+        "snapshot hash mangled on the int16 wire"
+    )
+    assert int(cl.get("applied", 2)) == int(cl.get("applied", 0))
+
+
+def test_snapshot_hash_int32_wire_unchanged():
+    cl = _snapshot_catchup(wire16=False)
+    lh, fh = int(cl.get("applied_hash", 0)), int(cl.get("applied_hash", 2))
+    assert fh == lh
