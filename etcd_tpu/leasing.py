@@ -1,0 +1,247 @@
+"""clientv3/leasing parity: serve linearizable reads from a local cache by
+owning per-key leasing keys (client/v3/leasing/kv.go, cache.go, doc.go).
+
+Protocol (doc.go:14-46): a Get on key ``k`` tries to acquire the leasing
+key ``<pfx>/k`` bound to the client's session lease; while owned, reads of
+``k`` are served from the local cache and writes go through ownership-
+guarded txns that refresh the cache. Another client writing ``k`` first
+requests revocation by overwriting ``<pfx>/k`` with a revoke marker; the
+owner answers by deleting the leasing key (relinquishing), which unblocks
+the writer. Session-lease expiry deletes every leasing key the owner held,
+releasing its claims wholesale.
+
+In-process adaptation: the reference owner reacts from a background
+watch goroutine (kv.go:70-78 monitorSession + leases watcher). Here each
+``LeasingKV`` drains its watch in ``pump()``, and a writer waiting on a
+revocation pumps every sibling LeasingKV registered on the same cluster —
+the synchronous analog of goroutine scheduling, matching the repo's
+step-and-recheck concurrency idiom (concurrency.py Mutex.lock). A dead
+owner (closed process, no pump) is broken by the same fallback the
+reference gets from lease expiry: the writer deletes the leasing key
+itself once the owner's claim is stale.
+"""
+from __future__ import annotations
+
+import dataclasses
+import weakref
+
+from etcd_tpu.client import Client
+from etcd_tpu.concurrency import ConcurrencyError, Session
+from etcd_tpu.server.kvserver import Op
+
+REVOKE = b"REVOKE"  # revoke-request marker (the reference bumps a rev
+# counter in the leasing key value, leasing/txn.go:33-58; a marker value
+# carries the same one-bit "please relinquish" signal)
+
+class _Registry:
+    """Every LeasingKV on one EtcdCluster, so a blocked writer can run its
+    siblings' watch loops (see module docstring). Keyed by a weak
+    reference to the cluster itself: a collected cluster drops its whole
+    entry, and ids are never reused across live objects."""
+    def __init__(self):
+        self.by_cluster: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
+
+    def add(self, kv: "LeasingKV") -> None:
+        self.by_cluster.setdefault(kv.c.ec, []).append(weakref.ref(kv))
+
+    def siblings(self, kv: "LeasingKV"):
+        refs = self.by_cluster.get(kv.c.ec, [])
+        live, out = [], []
+        for r in refs:
+            o = r()
+            if o is not None:
+                live.append(r)
+                out.append(o)
+        refs[:] = live
+        return out
+
+
+_registry = _Registry()
+
+
+class LeasingKV:
+    """leasingKV (leasing/kv.go:33-56) over the in-process client."""
+
+    def __init__(self, client: Client, pfx: bytes,
+                 session: Session | None = None, ttl: int = 60):
+        self.c = client
+        self.pfx = pfx.rstrip(b"/") + b"/"
+        self.session = session or Session(client, ttl)
+        # key -> leasing-key create_revision (our ownership proof)
+        self.owned: dict[bytes, int] = {}
+        # key -> cached KeyValue | None (None caches "key absent")
+        self.cache: dict[bytes, object] = {}
+        self.watch = client.watch_prefix(self.pfx)
+        _registry.add(self)
+
+    def close(self) -> None:
+        """Close(): relinquish everything (kv.go:81-84)."""
+        for key in list(self.owned):
+            self._relinquish(key)
+        self.session.close()
+
+    # -- ownership bookkeeping --------------------------------------------
+    def _lkey(self, key: bytes) -> bytes:
+        return self.pfx + key
+
+    def _relinquish(self, key: bytes) -> None:
+        crev = self.owned.pop(key, None)
+        self.cache.pop(key, None)
+        if crev is None:
+            return
+        c = self.c
+        # delete only our own claim: a newer claimant's leasing key has a
+        # different create revision
+        c.txn().if_(c.compare_create(self._lkey(key), "=", crev)).then(
+            Op("delete", self._lkey(key))
+        ).commit()
+
+    def pump(self) -> None:
+        """Drain the leasing-key watch: answer revoke requests on keys we
+        own and drop claims whose leasing key was deleted out from under
+        us (lease expiry / forced break). The in-process analog of the
+        reference's background watcher (leasing/kv.go:360-420)."""
+        for ev in self.watch.events():
+            key = ev.kv.key[len(self.pfx):]
+            if key not in self.owned:
+                continue
+            if ev.type == "put" and ev.kv.value == REVOKE:
+                self._relinquish(key)
+            elif ev.type == "delete":
+                # our claim is gone (expiry or a writer broke it)
+                self.owned.pop(key, None)
+                self.cache.pop(key, None)
+
+    # -- reads --------------------------------------------------------------
+    def get(self, key: bytes, rev: int = 0, serializable: bool = False):
+        """Get (kv.go:85-87 -> get): serve owned keys from the cache;
+        otherwise acquire the leasing key and cache the read. Historical
+        and serializable reads pass through uncached (leasing/kv.go:136-
+        141 skips acquisition for non-current reads)."""
+        if rev or serializable:
+            return self.c.get(key, rev=rev, serializable=serializable)
+        self.pump()
+        if key in self.owned and key in self.cache:
+            return self.cache[key]
+        c = self.c
+        res = (
+            c.txn()
+            .if_(c.compare_create(self._lkey(key), "=", 0))
+            .then(
+                Op("put", self._lkey(key), b"", lease=self.session.lease_id),
+                Op("range", key),
+            )
+            .else_(Op("range", key))
+            .commit()
+        )
+        if res["succeeded"]:
+            # strip the client namespace and copy, as Client.get does —
+            # txn range payloads come back as the store's own raw kvs
+            kvs = self.c._strip(res["responses"][1][1])
+            kv = kvs[0] if kvs else None
+            self.owned[key] = int(res["rev"])
+            self.cache[key] = kv
+            return kv
+        kvs = self.c._strip(res["responses"][0][1])
+        return kvs[0] if kvs else None
+
+    # -- writes -------------------------------------------------------------
+    def _wait_revoke(self, key: bytes, max_rounds: int = 200) -> None:
+        """Overwrite the leasing key with the revoke marker and wait for
+        the owner to relinquish (leasing/txn.go:33-58 + waitSession).
+        Pumps every sibling LeasingKV between cluster steps; if the owner
+        never answers, break its claim the way lease expiry would."""
+        c = self.c
+        lkey = self._lkey(key)
+        cur = c.get(lkey)
+        if cur is None:
+            return
+        c.put(lkey, REVOKE, lease=0)
+        for _ in range(max_rounds):
+            for kv in _registry.siblings(self):
+                if kv is not self:
+                    kv.pump()
+            if c.get(lkey) is None:
+                return
+            c.ec.step()
+        # dead owner: no pump will ever answer; expire the claim for it
+        c.delete(lkey)
+
+    def put(self, key: bytes, value: bytes, **kw):
+        self.pump()
+        c = self.c
+        if key in self.owned:
+            # ownership-guarded write-through + cache refresh (kv.go:
+            # put's txn asserts the leasing key is still ours)
+            res = (
+                c.txn()
+                .if_(c.compare_create(self._lkey(key), "=", self.owned[key]))
+                .then(Op("put", key, value, **kw))
+                .commit()
+            )
+            if res["succeeded"]:
+                mod = int(res["rev"])
+                prev = self.cache.get(key)
+                self.cache[key] = dataclasses.replace(
+                    prev, value=value, mod_revision=mod,
+                    version=prev.version + 1,
+                ) if prev is not None else _fresh_kv(key, value, mod)
+                return res
+            # lost the claim mid-flight: a NEW claimant may own the key
+            # now, so fall through to the full revoke protocol — a bare
+            # write would leave that owner serving its stale cache
+            self.owned.pop(key, None)
+            self.cache.pop(key, None)
+        self._wait_revoke(key)
+        return c.put(key, value, **kw)
+
+    def delete(self, key: bytes, **kw):
+        self.pump()
+        c = self.c
+        if key in self.owned:
+            res = (
+                c.txn()
+                .if_(c.compare_create(self._lkey(key), "=", self.owned[key]))
+                .then(Op("delete", key, **kw))
+                .commit()
+            )
+            if res["succeeded"]:
+                self.cache[key] = None
+                return res
+            self.owned.pop(key, None)
+            self.cache.pop(key, None)
+        self._wait_revoke(key)
+        return c.delete(key, **kw)
+
+    def txn(self):
+        """Txn: revoke other claims on written keys, invalidate our own
+        cache for them, then pass through (a simplification of
+        leasing/txn.go's server-side evaluation: correctness is kept by
+        invalidation, locality of cached txns is not)."""
+        builder = self.c.txn()
+        orig_commit = builder.commit
+
+        def commit():
+            self.pump()
+            written = {
+                op.key for op in (builder._success + builder._failure)
+                if op.type in ("put", "delete")
+            }
+            for key in written:
+                if key in self.owned:
+                    self.cache.pop(key, None)
+                else:
+                    self._wait_revoke(key)
+            return orig_commit()
+
+        builder.commit = commit
+        return builder
+
+
+def _fresh_kv(key: bytes, value: bytes, rev: int):
+    from etcd_tpu.server.mvcc import KeyValue
+
+    return KeyValue(key=key, value=value, create_revision=rev,
+                    mod_revision=rev, version=1)

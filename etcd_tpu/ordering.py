@@ -1,0 +1,102 @@
+"""clientv3/ordering parity: a KV wrapper that refuses to serve reads whose
+revision regresses below anything this client has already seen.
+
+The reference (client/v3/ordering/kv.go:24-92) records the highest response
+revision returned so far; a Get/Txn whose header revision is LOWER than
+that means the balancer routed the request to a lagging member, and the
+configured ``OrderViolationFunc`` decides what to do — the stock closure
+(client/v3/ordering/util.go:27-42) rotates endpoints and gives up with
+``ErrNoGreaterRev`` once it has cycled them 5x over.
+
+The TPU-native analog routes serializable reads to explicit members of the
+in-process cluster instead of gRPC endpoints: a violation rotates
+``member``; linearizable reads (member=None) go through ReadIndex and
+cannot regress.
+"""
+from __future__ import annotations
+
+from etcd_tpu.client import Client
+
+
+class ErrNoGreaterRev(Exception):
+    """No cluster member has a revision >= the previously received one
+    (client/v3/ordering/util.go:25)."""
+
+
+def switch_endpoint_closure(n_members: int):
+    """NewOrderViolationSwitchEndpointClosure (util.go:27-42): rotate to
+    the next member; fail once every member was cycled 5x."""
+    state = {"count": 0}
+
+    def on_violation(kv: "OrderingKV", prev_rev: int) -> None:
+        if state["count"] > 5 * n_members:
+            raise ErrNoGreaterRev(
+                "no cluster members have a revision higher than the "
+                f"previously received revision {prev_rev}"
+            )
+        state["count"] += 1
+        kv.member = (kv.member + 1) % n_members
+
+    return on_violation
+
+
+class OrderingKV:
+    """kvOrdering (kv.go:29-92) over the in-process client."""
+
+    def __init__(self, client: Client, member: int = 0,
+                 on_violation=None):
+        self.c = client
+        self.member = member
+        self.prev_rev = 0
+        self.on_violation = on_violation or switch_endpoint_closure(
+            len(client.ec.members)
+        )
+
+    def _observe(self, rev: int) -> None:
+        if rev > self.prev_rev:
+            self.prev_rev = rev
+
+    def get(self, key: bytes, serializable: bool = True, **kw):
+        """Get with the revision-monotonicity retry loop (kv.go:53-76).
+        Returns the KeyValue (or None), like Client.get."""
+        kvs = self.get_range(key, None, serializable, **kw)["kvs"]
+        return kvs[0] if kvs else None
+
+    def get_range(self, key: bytes, range_end: bytes | None = None,
+                  serializable: bool = True, **kw):
+        prev = self.prev_rev
+        while True:
+            res = self.c.get_range(
+                key, range_end, serializable=serializable,
+                member=self.member if serializable else None, **kw,
+            )
+            rev = int(res["header"].revision)
+            if rev >= prev:
+                self._observe(rev)
+                return res
+            self.on_violation(self, prev)
+
+    def put(self, key: bytes, value: bytes, **kw):
+        res = self.c.put(key, value, **kw)
+        self._observe(int(res["rev"]))
+        return res
+
+    def delete(self, key: bytes, **kw):
+        res = self.c.delete(key, **kw)
+        self._observe(int(res["rev"]))
+        return res
+
+    def txn(self):
+        """Txn passthrough recording the response revision (kv.go:78-92:
+        txns are linearized through the leader, so they only ever advance
+        prev_rev)."""
+        builder = self.c.txn()
+        orig_commit = builder.commit
+
+        def commit():
+            res = orig_commit()
+            self._observe(int(res["rev"]))
+            return res
+
+        builder.commit = commit
+        return builder

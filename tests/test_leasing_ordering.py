@@ -1,0 +1,188 @@
+"""clientv3 leasing + ordering sub-package tests.
+
+Leasing (client/v3/leasing): acquisition on Get, cache-served owned reads,
+owner write-through with cache refresh, cross-client revocation, dead-owner
+claim breaking, and txn invalidation — the integration arcs of the
+reference's leasing tests (client/v3/leasing/kv_test.go TestLeasingGet /
+TestLeasingInterval / TestLeasingPutGet / TestLeasingRev).
+
+Ordering (client/v3/ordering): revision-monotonic reads with the
+endpoint-switching violation closure and ErrNoGreaterRev exhaustion
+(kv_test.go TestDetectKvOrderViolation / util_test.go).
+"""
+from __future__ import annotations
+
+import pytest
+
+from etcd_tpu.client import Client
+from etcd_tpu.concurrency import Session
+from etcd_tpu.leasing import REVOKE, LeasingKV
+from etcd_tpu.ordering import ErrNoGreaterRev, OrderingKV, switch_endpoint_closure
+from etcd_tpu.server.kvserver import EtcdCluster
+
+
+@pytest.fixture()
+def ec():
+    return EtcdCluster(n_members=3)
+
+
+def test_leasing_get_acquires_and_caches(ec):
+    cl = Client(ec)
+    cl.put(b"abc", b"123")
+    lkv = LeasingKV(cl, b"lease/")
+    assert lkv.get(b"abc").value == b"123"
+    # the leasing key exists, bound to the session lease
+    lk = cl.get(b"lease/abc")
+    assert lk is not None
+    assert b"abc" in lkv.owned
+    # cached read serves without touching the server's revision
+    rev0 = int(cl.get_range(b"abc")["header"].revision)
+    assert lkv.get(b"abc").value == b"123"
+    assert int(cl.get_range(b"abc")["header"].revision) == rev0
+
+
+def test_leasing_get_absent_key_cached(ec):
+    cl = Client(ec)
+    lkv = LeasingKV(cl, b"lease/")
+    assert lkv.get(b"nope") is None
+    assert lkv.get(b"nope") is None  # negative cache hit
+    assert b"nope" in lkv.owned
+
+
+def test_leasing_owner_put_refreshes_cache(ec):
+    cl = Client(ec)
+    cl.put(b"k", b"v1")
+    lkv = LeasingKV(cl, b"lease/")
+    kv1 = lkv.get(b"k")
+    lkv.put(b"k", b"v2")
+    kv2 = lkv.get(b"k")
+    assert kv2.value == b"v2"
+    assert kv2.version == kv1.version + 1
+    assert kv2.mod_revision > kv1.mod_revision
+    # the cache matches the server state
+    assert cl.get(b"k").value == b"v2"
+
+
+def test_leasing_revocation_between_clients(ec):
+    cl = Client(ec)
+    cl.put(b"abc", b"123")
+    lkv1 = LeasingKV(cl, b"lease/")
+    lkv2 = LeasingKV(cl, b"lease/")
+    assert lkv1.get(b"abc").value == b"123"
+    # lkv2's write must revoke lkv1's claim (doc.go:36-44)
+    lkv2.put(b"abc", b"456")
+    assert b"abc" not in lkv1.owned, "owner did not relinquish"
+    assert cl.get(b"lease/abc") is None, "leasing key not cleaned up"
+    # lkv1 re-reads through a fresh acquisition and sees the new value
+    assert lkv1.get(b"abc").value == b"456"
+
+
+def test_leasing_dead_owner_claim_broken(ec):
+    cl = Client(ec)
+    cl.put(b"k", b"v1")
+    session1 = Session(cl, ttl=60)
+    lkv1 = LeasingKV(cl, b"lease/", session=session1)
+    assert lkv1.get(b"k").value == b"v1"
+    # simulate a dead owner: drop it from the registry by deleting the
+    # object, so no pump ever answers the revoke request
+    del lkv1
+    lkv2 = LeasingKV(cl, b"lease/")
+    lkv2.put(b"k", b"v2")
+    assert cl.get(b"k").value == b"v2"
+    assert cl.get(b"lease/k") is None
+
+
+def test_leasing_session_close_releases_claims(ec):
+    cl = Client(ec)
+    cl.put(b"k", b"v1")
+    lkv1 = LeasingKV(cl, b"lease/")
+    lkv1.get(b"k")
+    lkv1.close()
+    assert cl.get(b"lease/k") is None
+    # a second client acquires without any revocation dance
+    lkv2 = LeasingKV(cl, b"lease/")
+    assert lkv2.get(b"k").value == b"v1"
+    assert b"k" in lkv2.owned
+
+
+def test_leasing_txn_invalidates_and_revokes(ec):
+    cl = Client(ec)
+    cl.put(b"a", b"1")
+    cl.put(b"b", b"2")
+    lkv1 = LeasingKV(cl, b"lease/")
+    lkv2 = LeasingKV(cl, b"lease/")
+    lkv1.get(b"a")          # lkv1 owns a
+    lkv2.get(b"b")          # lkv2 owns b
+    # lkv1 txn writes both: own cache for a invalidated, b revoked from lkv2
+    res = (
+        lkv1.txn()
+        .if_(cl.compare_value(b"a", "=", b"1"))
+        .then(Op_put(b"a", b"10"), Op_put(b"b", b"20"))
+        .commit()
+    )
+    assert res["succeeded"]
+    assert b"b" not in lkv2.owned
+    assert lkv1.get(b"a").value == b"10"
+    assert lkv2.get(b"b").value == b"20"
+
+
+def Op_put(key: bytes, value: bytes):
+    from etcd_tpu.server.kvserver import Op
+
+    return Op("put", key, value)
+
+
+def test_leasing_namespaced_client(ec):
+    cl = Client(ec, namespace=b"ns/")
+    cl.put(b"k", b"v")
+    lkv = LeasingKV(cl, b"lease/")
+    kv = lkv.get(b"k")
+    assert kv.key == b"k" and kv.value == b"v"  # namespace stripped
+    assert lkv.get(b"k").key == b"k"            # cached copy too
+    # the leasing key lives inside the namespace
+    assert Client(ec).get(b"ns/lease/k") is not None
+
+
+def test_ordering_monotonic_reads_rotate_members(ec):
+    cl = Client(ec)
+    cl.put(b"k", b"v1")
+    okv = OrderingKV(cl, member=0)
+    assert okv.get(b"k").value == b"v1"
+    high = okv.prev_rev
+    assert high > 0
+    # pin the reader to a member and rewind the client's view: a stale
+    # serializable read must trigger rotation, not a stale answer
+    okv.prev_rev = high + 5
+    with pytest.raises(ErrNoGreaterRev):
+        okv.get(b"k")
+    # after catching up, reads flow again
+    for _ in range(6):
+        cl.put(b"k", b"v2")
+    okv2 = OrderingKV(cl, member=0)
+    okv2.prev_rev = high + 5
+    assert okv2.get(b"k").value == b"v2"
+
+
+def test_ordering_violation_closure_counts(ec):
+    closure = switch_endpoint_closure(3)
+    cl = Client(ec)
+    okv = OrderingKV(cl, member=0, on_violation=closure)
+    members = []
+    # 5*n violations pass (rotating members), then the closure gives up —
+    # util.go:36's `count > 5*len(endpoints)` admits one extra increment
+    with pytest.raises(ErrNoGreaterRev):
+        for _ in range(20):
+            closure(okv, 99)
+            members.append(okv.member)
+    assert len(members) == 16
+    assert set(members) == {0, 1, 2}  # rotated through every member
+
+
+def test_ordering_observes_writes(ec):
+    cl = Client(ec)
+    okv = OrderingKV(cl)
+    okv.put(b"k", b"v")
+    assert okv.prev_rev > 0
+    r1 = okv.prev_rev
+    okv.txn().then(Op_put(b"k", b"v2")).commit()
+    assert okv.prev_rev > r1
