@@ -45,6 +45,13 @@ class Config:
     # (the priv-key= file contents of the reference flag)
     auth_token: str = "simple"
     auth_jwt_key: bytes | None = None
+    # --initial-cluster-state (config.go ClusterState): "new" boots a
+    # fresh cluster; "existing" joins one that already has data
+    initial_cluster_state: str = "new"
+    # --force-new-cluster (config.go ForceNewCluster): disaster recovery —
+    # restart from this data dir as a ONE-member cluster, discarding the
+    # other members (bootstrap.go:327-341)
+    force_new_cluster: bool = False
 
     def validate(self) -> None:
         if self.cluster_size < 1:
@@ -59,6 +66,13 @@ class Config:
             raise ValueError(f"unknown auth token provider {self.auth_token}")
         if self.auth_token.split(",")[0] == "jwt" and not self.auth_jwt_key:
             raise ValueError("auth_token=jwt requires auth_jwt_key")
+        if self.initial_cluster_state not in ("new", "existing"):
+            raise ValueError(
+                "initial cluster state must be 'new' or 'existing', got "
+                f"{self.initial_cluster_state!r}"
+            )
+        if self.force_new_cluster and not self.data_dir:
+            raise ValueError("force_new_cluster requires a data_dir")
 
 
 class Etcd:
@@ -76,14 +90,7 @@ class Etcd:
             pre_vote=cfg.pre_vote,
             check_quorum=cfg.check_quorum,
         )
-        self.server = EtcdCluster(
-            n_members=cfg.cluster_size,
-            cluster=Cluster(n_members=cfg.cluster_size, cfg=raft_cfg),
-            quota_bytes=cfg.quota_backend_bytes,
-            data_dir=cfg.data_dir,
-            auth_token=cfg.auth_token,
-            auth_jwt_key=cfg.auth_jwt_key,
-        )
+        self.server = self._bootstrap(cfg, raft_cfg)
         self.server.ensure_leader()
         self.compactor = Compactor(
             self.server, cfg.auto_compaction_mode,
@@ -98,6 +105,69 @@ class Etcd:
             self._ticker = threading.Thread(target=self._tick_loop,
                                             daemon=True)
             self._ticker.start()
+
+    @staticmethod
+    def _bootstrap(cfg: Config, raft_cfg) -> EtcdCluster:
+        """The cold-start selection tree (bootstrap.go:51-99): data on
+        disk (haveWAL) always wins and restarts the cluster from it;
+        otherwise initial_cluster_state picks between bootstrapping a new
+        cluster and joining an existing one.
+
+        | disk state        | new            | existing               |
+        |-------------------|----------------|------------------------|
+        | no data_dir       | fresh (memory) | error: nothing to join |
+        | empty dir         | fresh (wipes)  | error: nothing to join |
+        | any member data   | restart from disk; absent members catch  |
+        |                   | up from peers (missing_ok)               |
+        | + force_new_...   | 1-member cluster from member 0's data    |
+        """
+        import os
+
+        from etcd_tpu.harness.cluster import Cluster
+        from etcd_tpu.utils.logging import get_logger
+
+        kw = dict(
+            quota_bytes=cfg.quota_backend_bytes,
+            auth_token=cfg.auth_token,
+            auth_jwt_key=cfg.auth_jwt_key,
+        )
+        n = cfg.cluster_size
+        have = [
+            os.path.exists(EtcdCluster.member_db_path(cfg.data_dir, m))
+            for m in range(n)
+        ] if cfg.data_dir else []
+        if any(have):
+            # bootstrap.go:91 bootstrapWithWAL: on-disk state wins over
+            # the initial-cluster-state flag
+            if cfg.force_new_cluster:
+                # recover from the first member whose data survived —
+                # never silently start empty while peer data exists
+                src = have.index(True)
+                get_logger().warning(
+                    "forcing new cluster from member %d of %s",
+                    src, cfg.data_dir,
+                )
+                return EtcdCluster.boot_from_disk(
+                    cfg.data_dir, n_members=1, members=[src],
+                    cluster=Cluster(n_members=1, cfg=raft_cfg), **kw,
+                )
+            return EtcdCluster.boot_from_disk(
+                cfg.data_dir, n_members=n, missing_ok=True, uniform=False,
+                cluster=Cluster(n_members=n, cfg=raft_cfg), **kw,
+            )
+        if cfg.initial_cluster_state == "existing":
+            # bootstrapExistingClusterNoWAL (bootstrap.go:182) fails the
+            # same way when the named cluster cannot be reached
+            raise ValueError(
+                "initial_cluster_state='existing' but no member data "
+                f"exists under {cfg.data_dir!r}; nothing to join"
+            )
+        return EtcdCluster(
+            n_members=n,
+            cluster=Cluster(n_members=n, cfg=raft_cfg),
+            data_dir=cfg.data_dir,
+            **kw,
+        )
 
     @property
     def client_url(self) -> str:
@@ -134,6 +204,11 @@ class Etcd:
         if self._ticker:
             self._ticker.join(timeout=2)
         self.http.stop()
+        try:
+            # clean shutdown leaves every member at the committed front
+            self.server.sync_for_shutdown()
+        except Exception:
+            pass  # crashy members can't block close
         for ms in self.server.members:
             if ms.backend is not None:
                 ms.backend.close()

@@ -1,0 +1,218 @@
+"""raftpb conf-change value types + wire codec (raft/raftpb/confchange.go).
+
+The device fleet runs conf changes as packed int32 words (at most two
+changes — models/confchange.py), which covers every replicated-path use.
+This module is the HOST-side raftpb analog for everything around that
+core: full ``ConfChangeV2`` values with arbitrary change lists and
+context bytes, the v1 type, ``as_v1``/``as_v2`` conversion,
+``marshal_conf_change`` → (entry type, bytes), the EnterJoint/LeaveJoint
+classification (confchange.go:70-107), and the ``v1 l2 r3 u4`` string
+grammar (confchange.go:112-168) used by tests and tooling.
+
+The byte format is a little-endian varint TLV, not gogo-protobuf — the
+reference's generated marshalling is an implementation detail; what
+matters is a stable, self-describing round trip.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from etcd_tpu.models import confchange as ccmod
+from etcd_tpu.types import (
+    CC_ADD_LEARNER,
+    CC_ADD_NODE,
+    CC_REMOVE_NODE,
+    CC_UPDATE_NODE,
+    ENTRY_CONF_CHANGE,
+)
+
+# ConfChangeTransition (raft.pb.go): how joint consensus is entered/left
+TRANSITION_AUTO = 0
+TRANSITION_JOINT_IMPLICIT = 1
+TRANSITION_JOINT_EXPLICIT = 2
+
+_TYPE_CHARS = {
+    "v": CC_ADD_NODE,
+    "l": CC_ADD_LEARNER,
+    "r": CC_REMOVE_NODE,
+    "u": CC_UPDATE_NODE,
+}
+_CHAR_TYPES = {v: k for k, v in _TYPE_CHARS.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfChangeSingle:
+    """raftpb.ConfChangeSingle: one (type, node) operation."""
+
+    type: int
+    node_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfChange:
+    """Legacy v1 conf change (one operation, EntryConfChange)."""
+
+    type: int
+    node_id: int
+    context: bytes = b""
+
+    def as_v2(self) -> "ConfChangeV2":
+        return ConfChangeV2(
+            changes=(ConfChangeSingle(self.type, self.node_id),),
+            context=self.context,
+        )
+
+    def as_v1(self) -> "ConfChange | None":
+        return self
+
+    def marshal(self) -> bytes:
+        return b"\x01" + _enc_varint(self.type) + _enc_varint(
+            self.node_id
+        ) + _enc_bytes(self.context)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfChangeV2:
+    """raftpb.ConfChangeV2: N operations + transition + context."""
+
+    changes: tuple[ConfChangeSingle, ...] = ()
+    transition: int = TRANSITION_AUTO
+    context: bytes = b""
+
+    def as_v2(self) -> "ConfChangeV2":
+        return self
+
+    def as_v1(self) -> ConfChange | None:
+        return None
+
+    def enter_joint(self) -> tuple[bool, bool]:
+        """(autoLeave, useJoint) — confchange.go:70-99: joint consensus is
+        used for multi-change batches or any explicit transition."""
+        if self.transition != TRANSITION_AUTO or len(self.changes) > 1:
+            if self.transition in (TRANSITION_AUTO,
+                                   TRANSITION_JOINT_IMPLICIT):
+                return True, True
+            if self.transition == TRANSITION_JOINT_EXPLICIT:
+                return False, True
+            raise ValueError(f"unknown transition {self.transition}")
+        return False, False
+
+    def leave_joint(self) -> bool:
+        """confchange.go:101-107: zero value (context aside) = leave."""
+        return not self.changes and self.transition == TRANSITION_AUTO
+
+    def marshal(self) -> bytes:
+        out = [b"\x02", _enc_varint(self.transition),
+               _enc_varint(len(self.changes))]
+        for ch in self.changes:
+            out.append(_enc_varint(ch.type))
+            out.append(_enc_varint(ch.node_id))
+        out.append(_enc_bytes(self.context))
+        return b"".join(out)
+
+
+def marshal_conf_change(cc) -> tuple[int, bytes]:
+    """MarshalConfChange (confchange.go:34-47): v1 values keep the legacy
+    entry type; everything else marshals as v2."""
+    from etcd_tpu.types import ENTRY_CONF_CHANGE_V2
+
+    v1 = cc.as_v1()
+    if v1 is not None:
+        return ENTRY_CONF_CHANGE, v1.marshal()
+    return ENTRY_CONF_CHANGE_V2, cc.as_v2().marshal()
+
+
+def unmarshal_conf_change(data: bytes):
+    """Inverse of ConfChange/ConfChangeV2.marshal (tag byte selects)."""
+    if not data:
+        raise ValueError("empty conf-change payload")
+    tag, pos = data[0], 1
+    if tag == 1:
+        typ, pos = _dec_varint(data, pos)
+        nid, pos = _dec_varint(data, pos)
+        ctx, pos = _dec_bytes(data, pos)
+        return ConfChange(typ, nid, ctx)
+    if tag == 2:
+        tr, pos = _dec_varint(data, pos)
+        n, pos = _dec_varint(data, pos)
+        chs = []
+        for _ in range(n):
+            typ, pos = _dec_varint(data, pos)
+            nid, pos = _dec_varint(data, pos)
+            chs.append(ConfChangeSingle(typ, nid))
+        ctx, pos = _dec_bytes(data, pos)
+        return ConfChangeV2(tuple(chs), tr, ctx)
+    raise ValueError(f"bad conf-change tag {tag}")
+
+
+# -- string grammar (confchange.go:112-168) ---------------------------------
+def conf_changes_from_string(s: str) -> tuple[ConfChangeSingle, ...]:
+    """Parse "v1 l2 r3 u4" (0-based ids are the caller's concern; this
+    keeps the reference's 1-based surface verbatim)."""
+    out = []
+    for tok in s.split():
+        if tok[0] not in _TYPE_CHARS:
+            raise ValueError(f"unknown input: {tok}")
+        out.append(ConfChangeSingle(_TYPE_CHARS[tok[0]], int(tok[1:])))
+    return tuple(out)
+
+
+def conf_changes_to_string(ccs) -> str:
+    return " ".join(f"{_CHAR_TYPES[c.type]}{c.node_id}" for c in ccs)
+
+
+# -- device-word bridge ------------------------------------------------------
+def to_word(cc) -> int:
+    """Pack for the device fleet (models/confchange.py layout). Only
+    batches of <= 2 changes exist on the replicated device path; larger
+    batches stay host-side (the leader's joint guard demotes them before
+    they ever reach a device entry)."""
+    v2 = cc.as_v2()
+    if v2.leave_joint():
+        return ccmod.encode_leave_joint()
+    if len(v2.changes) > 2:
+        raise ValueError(
+            "device conf-change words carry at most 2 changes; "
+            f"got {len(v2.changes)}"
+        )
+    auto, joint = v2.enter_joint()
+    return ccmod.encode(
+        [(c.type, c.node_id) for c in v2.changes],
+        enter_joint=joint, auto_leave=auto,
+    )
+
+
+def _enc_varint(v: int) -> bytes:
+    if v < 0:
+        raise ValueError("varint must be non-negative")
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | (0x80 if v else 0))
+        if not v:
+            return bytes(out)
+
+
+def _dec_varint(data: bytes, pos: int) -> tuple[int, int]:
+    shift = v = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, pos
+        shift += 7
+
+
+def _enc_bytes(b: bytes) -> bytes:
+    return _enc_varint(len(b)) + b
+
+
+def _dec_bytes(data: bytes, pos: int) -> tuple[bytes, int]:
+    n, pos = _dec_varint(data, pos)
+    if pos + n > len(data):
+        raise ValueError("truncated bytes field")
+    return data[pos:pos + n], pos + n

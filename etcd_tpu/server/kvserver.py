@@ -157,10 +157,14 @@ class EtcdCluster:
                 ms.backend = Backend(self._backend_path(m), fresh=True)
         self._root_token: str | None = None
 
-    def _backend_path(self, m: int) -> str:
+    @staticmethod
+    def member_db_path(data_dir: str, m: int) -> str:
         import os
 
-        return os.path.join(self.data_dir, f"member{m}.db")
+        return os.path.join(data_dir, f"member{m}.db")
+
+    def _backend_path(self, m: int) -> str:
+        return self.member_db_path(self.data_dir, m)
 
     def _new_auth(self) -> AuthStore:
         return AuthStore(token=self.auth_token, jwt_key=self.auth_jwt_key)
@@ -202,6 +206,20 @@ class EtcdCluster:
     def step(self) -> None:
         self.cl.step()
         self._pump()
+
+    def sync_for_shutdown(self, max_rounds: int = 16) -> None:
+        """Drain commit -> apply -> persist before a clean close, so every
+        member's backend reaches the committed front. A reference follower
+        gets this durability from WAL replay of its committed tail
+        (storage.go MustSync + bootstrapWithWAL); here the device ring is
+        the log and dies with the process, so the drain runs eagerly."""
+        for _ in range(max_rounds):
+            live = [
+                ms.applied_index for ms in self.members if not ms.crashed
+            ]
+            if len(set(live)) <= 1:
+                return
+            self.step()
 
     def stabilize(self, max_rounds: int = 64) -> None:
         self.cl.step()
@@ -393,6 +411,9 @@ class EtcdCluster:
         cls,
         data_dir: str,
         n_members: int = 3,
+        missing_ok: bool = False,
+        uniform: bool = True,
+        members: list[int] | None = None,
         **kw,
     ) -> "EtcdCluster":
         """Boot a cluster from an EXISTING data dir (the bootstrapWithWAL /
@@ -402,27 +423,67 @@ class EtcdCluster:
         from a synthetic snapshot at the restored consistent index — the
         analog of the fresh WAL whose first record is the snapshot marker
         that `etcdutl snapshot restore` writes. Contrast __init__ with
-        data_dir=..., which wipes for a fresh incarnation."""
+        data_dir=..., which wipes for a fresh incarnation.
+
+        ``missing_ok``: members whose backend file is absent boot empty
+        and catch up from a peer snapshot — the in-process analog of
+        bootstrapExistingClusterNoWAL (bootstrap.go:182): a data-less
+        member joining a cluster that already has state.
+
+        ``uniform``: require every present member at ONE consistent index
+        (the etcdutl-restore contract — a restored dir is written from a
+        single snapshot). Restarting a live data dir (embed's haveWAL
+        path) passes False: members legitimately shut down a few applied
+        entries apart, and the laggards catch up from the most advanced
+        peer exactly as a slow member would at runtime.
+
+        ``members``: which on-disk member files back each new member
+        (defaults to identity). force-new-cluster passes the surviving
+        member's index so a 1-member recovery can start from whichever
+        data file still exists; the loaded backend stays bound to that
+        file, so subsequent persists continue it."""
+        import os
+
         from etcd_tpu.storage.backend import Backend
 
         ec = cls(n_members=n_members, **kw)  # memory boot; no wipe
         ec.data_dir = data_dir
+        disk = members if members is not None else list(range(ec.M))
+        if len(disk) != ec.M:
+            raise ServerError(
+                f"members maps {len(disk)} disk files onto {ec.M} members"
+            )
         metas = []
+        missing: list[int] = []
         for m in range(ec.M):
-            be = Backend(ec._backend_path(m))
+            path = cls.member_db_path(data_dir, disk[m])
+            if missing_ok and not os.path.exists(path):
+                missing.append(m)
+                metas.append(None)
+                continue
+            be = Backend(path)
             ms, meta = ec._member_from_backend(be)
             ec.members[m] = ms
             metas.append(meta)
-        idx = max(meta["consistent_index"] for meta in metas)
-        term = max(meta["term"] for meta in metas)
+        present = [meta for meta in metas if meta is not None]
+        if not present:
+            raise ServerError(
+                f"no member data found under {data_dir}; cannot join an "
+                "existing cluster that has none"
+            )
+        idx = max(meta["consistent_index"] for meta in present)
+        term = max(meta["term"] for meta in present)
+        behind: list[int] = []
         for m, meta in enumerate(metas):
-            if meta["consistent_index"] != idx:
-                raise ServerError(
-                    f"member {m} restored at index "
-                    f"{meta['consistent_index']} != {idx}; a restored "
-                    "data dir must be uniform (snapshot restore writes "
-                    "every member from the same snapshot)"
-                )
+            if meta is not None and meta["consistent_index"] != idx:
+                if uniform:
+                    raise ServerError(
+                        f"member {m} restored at index "
+                        f"{meta['consistent_index']} != {idx}; a restored "
+                        "data dir must be uniform (snapshot restore writes "
+                        "every member from the same snapshot)"
+                    )
+                behind.append(m)
         if idx > 0:
             # synthetic device snapshot: log starts at (idx, term) with an
             # empty tail, exactly like handle_snapshot's restore field set
@@ -436,6 +497,20 @@ class EtcdCluster:
                     applied_hash=0, snap_hash=0,
                 )
             ec._gc_floor = idx
+        for m in missing:
+            # data-less joiner: fresh backend + applied state from the
+            # most advanced restored peer, then persist the baseline
+            ec.members[m].backend = Backend(
+                cls.member_db_path(data_dir, disk[m]), fresh=True
+            )
+            if idx > 0:
+                ec._install_peer_snapshot(m, ec.members[m], idx)
+            ec._persist(ec.members[m], term)
+        for m in behind:
+            # shut down a few entries behind the front: catch up the
+            # applied state machine from the most advanced peer
+            ec._install_peer_snapshot(m, ec.members[m], idx)
+            ec._persist(ec.members[m], term)
         return ec
 
     def _install_peer_snapshot(self, m: int, ms: "MemberState",

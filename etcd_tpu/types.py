@@ -58,7 +58,10 @@ NUM_MSG_TYPES = 18
 
 # Entry types (raft.proto:69-74)
 ENTRY_NORMAL = 0
-ENTRY_CONF_CHANGE = 1  # we only model the V2-equivalent, encoded in data
+ENTRY_CONF_CHANGE = 1  # the device models the V2-equivalent, packed in data
+# host-side raftpb surface (etcd_tpu/raftpb.py) distinguishes the wire
+# entry types the way MarshalConfChange does (raftpb/confchange.go:34-47)
+ENTRY_CONF_CHANGE_V2 = 2
 
 # Vote results (reference quorum/quorum.go:50-58)
 VOTE_PENDING = 0

@@ -1,0 +1,104 @@
+"""embed cold-start selection tree (bootstrap.go:51-99): new vs existing
+vs restart-from-disk vs force-new-cluster, selected from on-disk state +
+config flags. Data on disk always wins: an embed restart RESUMES the
+cluster (the reference never wipes a data dir), absent members catch up
+from peers, and force_new_cluster rebuilds a one-member cluster for
+disaster recovery (bootstrap.go:327-341).
+"""
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from etcd_tpu.client import Client
+from etcd_tpu.embed import Config, start_etcd
+
+
+def _cfg(tmp_path, **kw):
+    return Config(
+        data_dir=str(tmp_path / "data"), auto_tick=False, cluster_size=3,
+        **kw,
+    )
+
+
+def test_new_then_restart_resumes_data(tmp_path):
+    e = start_etcd(_cfg(tmp_path))
+    cl = Client(e.server)
+    cl.put(b"k", b"v1")
+    rev = int(cl.get_range(b"k")["header"].revision)
+    e.close()
+
+    # same dir, second incarnation: haveWAL wins -> restart from disk
+    e2 = start_etcd(_cfg(tmp_path))
+    cl2 = Client(e2.server)
+    kv = cl2.get(b"k")
+    assert kv is not None and kv.value == b"v1", "restart wiped the data dir"
+    assert int(cl2.get_range(b"k")["header"].revision) >= rev
+    cl2.put(b"k", b"v2")  # still writable
+    assert cl2.get(b"k").value == b"v2"
+    e2.close()
+
+
+def test_existing_without_data_refuses(tmp_path):
+    with pytest.raises(ValueError, match="nothing to join"):
+        start_etcd(_cfg(tmp_path, initial_cluster_state="existing"))
+    # and entirely without a data dir
+    with pytest.raises(ValueError, match="nothing to join"):
+        start_etcd(Config(auto_tick=False,
+                          initial_cluster_state="existing"))
+
+
+def test_absent_member_catches_up_from_peers(tmp_path):
+    e = start_etcd(_cfg(tmp_path))
+    cl = Client(e.server)
+    for i in range(5):
+        cl.put(b"k%d" % i, b"v%d" % i)
+    e.close()
+
+    # lose one member's data file; the restart boots it empty and
+    # installs a peer snapshot (bootstrapExistingClusterNoWAL analog)
+    os.remove(os.path.join(str(tmp_path / "data"), "member2.db"))
+    e2 = start_etcd(_cfg(tmp_path, initial_cluster_state="existing"))
+    e2.server.corruption_check()  # every member at one hash
+    cl2 = Client(e2.server)
+    assert cl2.get(b"k4").value == b"v4"
+    e2.close()
+
+
+def test_force_new_cluster_single_member(tmp_path):
+    e = start_etcd(_cfg(tmp_path))
+    Client(e.server).put(b"k", b"v1")
+    e.close()
+
+    e2 = start_etcd(_cfg(tmp_path, force_new_cluster=True))
+    assert len(e2.server.members) == 1
+    cl2 = Client(e2.server)
+    assert cl2.get(b"k").value == b"v1"
+    cl2.put(b"k2", b"v2")  # one-member cluster commits alone
+    assert cl2.get(b"k2").value == b"v2"
+    e2.close()
+
+
+def test_force_new_cluster_survives_member0_loss(tmp_path):
+    """Disaster case: member 0's file is gone; recovery must come from a
+    surviving member's data, never a silently-empty cluster."""
+    e = start_etcd(_cfg(tmp_path))
+    Client(e.server).put(b"k", b"v1")
+    e.close()
+
+    os.remove(os.path.join(str(tmp_path / "data"), "member0.db"))
+    e2 = start_etcd(_cfg(tmp_path, force_new_cluster=True))
+    assert len(e2.server.members) == 1
+    kv = Client(e2.server).get(b"k")
+    assert kv is not None and kv.value == b"v1", (
+        "force_new_cluster discarded surviving member data"
+    )
+    e2.close()
+
+
+def test_validate_rejects_bad_flags(tmp_path):
+    with pytest.raises(ValueError, match="initial cluster state"):
+        Config(initial_cluster_state="maybe").validate()
+    with pytest.raises(ValueError, match="force_new_cluster"):
+        Config(force_new_cluster=True).validate()

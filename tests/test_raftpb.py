@@ -1,0 +1,88 @@
+"""Host-side raftpb conf-change surface (raft/raftpb/confchange.go):
+v1/v2 conversion, EnterJoint/LeaveJoint classification, marshalling round
+trips, the string grammar, and the device-word bridge.
+"""
+import pytest
+
+from etcd_tpu import raftpb as pb
+from etcd_tpu.models import confchange as ccmod
+from etcd_tpu.types import (
+    CC_ADD_LEARNER,
+    CC_ADD_NODE,
+    CC_REMOVE_NODE,
+    ENTRY_CONF_CHANGE,
+    ENTRY_CONF_CHANGE_V2,
+)
+
+
+def test_v1_as_v2_and_marshal_type():
+    cc = pb.ConfChange(CC_ADD_NODE, 3, b"ctx")
+    v2 = cc.as_v2()
+    assert v2.changes == (pb.ConfChangeSingle(CC_ADD_NODE, 3),)
+    assert v2.context == b"ctx"
+    typ, data = pb.marshal_conf_change(cc)
+    assert typ == ENTRY_CONF_CHANGE
+    rt = pb.unmarshal_conf_change(data)
+    assert rt == cc
+
+
+def test_v2_marshal_round_trip():
+    v2 = pb.ConfChangeV2(
+        changes=(
+            pb.ConfChangeSingle(CC_ADD_NODE, 2),
+            pb.ConfChangeSingle(CC_ADD_LEARNER, 3),
+            pb.ConfChangeSingle(CC_REMOVE_NODE, 300),  # multi-byte varint
+        ),
+        transition=pb.TRANSITION_JOINT_EXPLICIT,
+        context=b"\x00\xff payload",
+    )
+    typ, data = pb.marshal_conf_change(v2)
+    assert typ == ENTRY_CONF_CHANGE_V2
+    assert pb.unmarshal_conf_change(data) == v2
+
+
+def test_enter_leave_joint_classification():
+    one = pb.ConfChangeV2((pb.ConfChangeSingle(CC_ADD_NODE, 1),))
+    assert one.enter_joint() == (False, False)  # simple protocol
+    two = pb.ConfChangeV2(
+        (pb.ConfChangeSingle(CC_ADD_NODE, 1),
+         pb.ConfChangeSingle(CC_ADD_NODE, 2)),
+    )
+    assert two.enter_joint() == (True, True)  # auto -> autoleave joint
+    explicit = pb.ConfChangeV2(
+        one.changes, transition=pb.TRANSITION_JOINT_EXPLICIT
+    )
+    assert explicit.enter_joint() == (False, True)
+    implicit = pb.ConfChangeV2(
+        one.changes, transition=pb.TRANSITION_JOINT_IMPLICIT
+    )
+    assert implicit.enter_joint() == (True, True)
+    assert pb.ConfChangeV2().leave_joint()
+    assert pb.ConfChangeV2(context=b"x").leave_joint()  # context ignored
+    assert not one.leave_joint()
+
+
+def test_string_grammar_round_trip():
+    ccs = pb.conf_changes_from_string("v1 l2 r3 u4")
+    assert [c.node_id for c in ccs] == [1, 2, 3, 4]
+    assert pb.conf_changes_to_string(ccs) == "v1 l2 r3 u4"
+    with pytest.raises(ValueError, match="unknown input"):
+        pb.conf_changes_from_string("x9")
+
+
+def test_device_word_bridge():
+    v2 = pb.ConfChangeV2(
+        (pb.ConfChangeSingle(CC_ADD_NODE, 1),
+         pb.ConfChangeSingle(CC_ADD_LEARNER, 2)),
+    )
+    w = pb.to_word(v2)
+    assert w == ccmod.encode(
+        [(CC_ADD_NODE, 1), (CC_ADD_LEARNER, 2)],
+        enter_joint=True, auto_leave=True,
+    )
+    assert pb.to_word(pb.ConfChangeV2()) == ccmod.encode_leave_joint()
+    three = pb.ConfChangeV2(
+        tuple(pb.ConfChangeSingle(CC_ADD_NODE, i) for i in range(3))
+    )
+    with pytest.raises(ValueError, match="at most 2"):
+        pb.to_word(three)
