@@ -19,6 +19,13 @@ import jax
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+# CHAOS_PRNG=rbg swaps the PRNG for the cheaper hardware generator —
+# measured and rejected as the default: the flag is global, so it also
+# changes the fleet's election-timeout randomization, and a 262k run left
+# 32 groups split-voting past the heal budget (threefry recovers fully).
+if os.environ.get("CHAOS_PRNG", "threefry") == "rbg":
+    jax.config.update("jax_default_prng_impl", "rbg")
+
 os.makedirs(os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"),
             exist_ok=True)
 jax.config.update(
