@@ -1,0 +1,87 @@
+"""pkg/wait + server/mock analogs (utils/wait.py, harness/mock.py):
+register/trigger matching, logical-deadline waits, duplicate-id refusal
+(wait_test.go), and the recording/error-injecting storage double driving
+a real RawNode error path.
+"""
+import pytest
+
+from etcd_tpu.harness.mock import RecordingStorage, RecordingWait
+from etcd_tpu.storage.raftstorage import Entry, ErrCompacted, MemoryStorage
+from etcd_tpu.utils.wait import Wait, WaitTime
+
+
+def test_wait_register_trigger():
+    w = Wait()
+    a = w.register(1)
+    b = w.register(2)
+    assert w.is_registered(1) and w.is_registered(2)
+    w.trigger(1, "one")
+    assert a.done and a.value == "one"
+    assert not b.done
+    assert not w.is_registered(1)
+    w.trigger(2, "two")
+    assert b.wait(timeout=1) == "two"
+
+
+def test_wait_duplicate_id_refused():
+    w = Wait()
+    w.register(7)
+    with pytest.raises(ValueError, match="duplicate id"):
+        w.register(7)
+
+
+def test_wait_trigger_unregistered_is_noop():
+    Wait().trigger(99, "x")  # wait.go Trigger on empty id: nothing
+
+
+def test_wait_time_deadlines():
+    wt = WaitTime()
+    w1 = wt.wait(1)
+    w2 = wt.wait(2)
+    w4 = wt.wait(4)
+    wt.trigger(2)
+    assert w1.done and w2.done and not w4.done
+    # deadlines at or before the last trigger complete immediately
+    assert wt.wait(2).done
+    assert not wt.wait(5).done
+    wt.trigger(10)
+    assert w4.done
+
+
+def test_recording_storage_records_and_injects():
+    rs = RecordingStorage(MemoryStorage())
+    rs.append([Entry(index=1, term=1)])
+    rs.last_index()
+    assert rs.names() == ["append", "last_index"]
+    rs.fail["entries"] = ErrCompacted()
+    with pytest.raises(ErrCompacted):
+        rs.entries(1, 2)
+    # one-shot: the next call goes through to the real storage
+    assert [e.index for e in rs.entries(1, 2)] == [1]
+
+
+def test_recording_storage_drives_rawnode():
+    from etcd_tpu.models.rawnode import RawNode
+    from etcd_tpu.types import Spec
+    from etcd_tpu.utils.config import RaftConfig
+
+    spec = Spec(M=1, L=16, E=2, K=2, W=4, R=2, A=4)
+    rs = RecordingStorage(MemoryStorage())
+    rn = RawNode(RaftConfig(), spec, rs, nid=0)
+    rn.campaign()
+    rd = rn.ready()
+    if rd.hard_state is not None:
+        rs.set_hard_state(rd.hard_state)
+    rs.append(rd.entries)
+    rn.advance(rd)
+    names = rs.names()
+    # boot reads the contract, then the harness persists the Ready
+    assert "initial_state" in names
+    assert names[-1] == "append"
+
+
+def test_recording_wait():
+    rw = RecordingWait()
+    rw.register(3)
+    rw.trigger(3, "v")
+    assert rw.actions == [("Register", 3), ("Trigger", 3)]
