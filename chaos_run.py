@@ -56,9 +56,18 @@ def main() -> int:
     spec = Spec(M=5, L=L, E=1, K=2, W=4, R=2, A=2)
     bound = int(os.environ.get("CHAOS_BOUND", str(spec.M - 1)))
     wire16 = os.environ.get("CHAOS_WIRE16", "1") != "0"
+    # fleet chunking caps the round program's HLO temporaries, exactly as
+    # in bench.py — above ~262k resident groups the un-chunked chaos
+    # round overflows HBM by mere tens of MB. Chunks of 131,072 (the
+    # bench-proven shape) run clean; 262,144-wide chunks at C=524k
+    # reproducibly crashed the TPU worker.
+    chunks = int(os.environ.get(
+        "CHAOS_CHUNKS",
+        str(max(1, C // 131072)) if on_accel and C > 262144 else "1",
+    ))
     cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
                      inbox_bound=bound, coalesce_commit_refresh=True,
-                     wire_int16=wire16)
+                     wire_int16=wire16, fleet_chunks=chunks)
 
     t0 = time.perf_counter()
     rep = run_chaos(

@@ -118,8 +118,11 @@ def build_chaos_epoch(
     """One jitted chaos epoch: `rounds` lockstep rounds of faulted traffic
     with per-round invariant checks.
 
-    Returns fn(state, inbox, held, key, prop_len, prop_data, viol,
-    prev_commit) -> (state, inbox, held, key, viol, commits_delta).
+    Returns fn(state, inbox, held, key, prop_len, prop_data, viol)
+    -> (state, inbox, held, key, viol, commits_delta). The regression
+    baseline (prev_commit) starts at the entry state's own commit —
+    nothing moves between epochs, so passing it across the boundary
+    would merely alias a leaf of the donated state.
 
     Partitions re-sample every `partition_period` rounds: each group is
     partitioned with probability partition_p into two random sides (links
@@ -129,8 +132,8 @@ def build_chaos_epoch(
     M = spec.M
     faultless = drop_p == 0.0 and delay_p == 0.0 and partition_p == 0.0
 
-    def epoch(state, inbox, held, key, prop_len, prop_data, viol,
-              prev_commit):
+    def epoch(state, inbox, held, key, prop_len, prop_data, viol):
+        prev_commit = state.commit
         C = state.term.shape[-1]
         zp = jnp.zeros((M, spec.E, C), jnp.int32)
         z2 = jnp.zeros((M, C), jnp.int32)
@@ -231,25 +234,26 @@ def run_chaos(
         prop_len = prop_len.at[0].set(1)
         prop_data = prop_data.at[0, 0].set(7)
 
+    # donate the fleet-sized carries (state/inbox/held): without this the
+    # epochs double-buffer the whole resident fleet and large-C runs that
+    # compile fine die at runtime allocation
     chaos = jax.jit(build_chaos_epoch(
         cfg, spec, epoch_len, drop_p, delay_p, partition_p
-    ))
-    heal = jax.jit(build_chaos_epoch(cfg, spec, heal_len, 0.0, 0.0, 0.0))
+    ), donate_argnums=(0, 1, 2))
+    heal = jax.jit(build_chaos_epoch(cfg, spec, heal_len, 0.0, 0.0, 0.0),
+                   donate_argnums=(0, 1, 2))
 
     viol = zero_violations()
-    prev_commit = state.commit
     commits = []
     done = 0
     while done < rounds:
         state, inbox, held, key, viol, dc = chaos(
-            state, inbox, held, key, prop_len, prop_data, viol, prev_commit
+            state, inbox, held, key, prop_len, prop_data, viol
         )
-        prev_commit = state.commit
         done += epoch_len
         state, inbox, held, key, viol, dh = heal(
-            state, inbox, held, key, prop_len, prop_data, viol, prev_commit
+            state, inbox, held, key, prop_len, prop_data, viol
         )
-        prev_commit = state.commit
         done += heal_len
         commits.append((int(dc), int(dh)))
 
@@ -264,9 +268,8 @@ def run_chaos(
         if leaders() == C:
             break
         state, inbox, held, key, viol, dh = heal(
-            state, inbox, held, key, prop_len, prop_data, viol, prev_commit
+            state, inbox, held, key, prop_len, prop_data, viol
         )
-        prev_commit = state.commit
         done += heal_len
         commits.append((0, int(dh)))
     has_leader = leaders()
