@@ -34,18 +34,35 @@ class Cluster:
         seed: int = 0,
     ):
         spec = spec or Spec(M=n_members)
+        # canonical lane padding: each distinct C value re-traces the whole
+        # jitted round (~30s+ of pjit tracing on the test VM), so every
+        # cluster up to 16 lanes shares ONE 16-lane program per
+        # (cfg, spec); the extra lanes stay idle followers (never hupped
+        # or ticked — execution cost on the tiny test shapes is dispatch-
+        # bound, not element-bound) and every accessor below indexes an
+        # explicit c < self.C
+        self.C = C
+        self._Cp = 16 if C <= 16 else C
         if voters is not None:
             voters = jnp.asarray(voters, jnp.bool_)
+            if voters.ndim == 2 and voters.shape[0] != self._Cp:
+                voters = jnp.concatenate(
+                    [voters] + [voters[:1]] * (self._Cp - voters.shape[0])
+                )
         if learners is not None:
             learners = jnp.asarray(learners, jnp.bool_)
-        self.eng = RaftEngine(spec, cfg, C, voters, learners, seed)
-        self.spec, self.cfg, self.C = spec, cfg, C
+            if learners.ndim == 2 and learners.shape[0] != self._Cp:
+                learners = jnp.concatenate(
+                    [learners] + [learners[:1]] * (self._Cp - learners.shape[0])
+                )
+        self.eng = RaftEngine(spec, cfg, self._Cp, voters, learners, seed)
+        self.spec, self.cfg = spec, cfg
         self._next_ctx = 1
         self._reset_inputs()
 
     # -- queued inputs applied on the next round ----------------------------
     def _reset_inputs(self):
-        C, M, E = self.C, self.spec.M, self.spec.E
+        C, M, E = self._Cp, self.spec.M, self.spec.E
         self._hup = np.zeros((M, C), bool)
         self._plen = np.zeros((M, C), np.int32)
         self._pdata = np.zeros((M, E, C), np.int32)

@@ -114,6 +114,19 @@ def init_fleet(
     elif learners.ndim == 1:
         learners = jnp.broadcast_to(learners, (C, spec.M))
 
+    return _init_fleet_core(
+        spec, C, election_tick, voters, learners,
+        jnp.asarray(seed, jnp.int32),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2))
+def _init_fleet_core(spec: Spec, C: int, election_tick: int,
+                     voters, learners, seed):
+    """Jitted: an EAGER nested vmap here traced init_node through the
+    batching interpreter on every cluster construction (~seconds each;
+    at suite scale that tracing dominated wall time)."""
+
     def one(c, m):
         return init_node(
             spec, m, voters[c], learners[c], seed=c * 1_000_003 + seed,
@@ -246,6 +259,15 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
     return round_fn
 
 
+@functools.lru_cache(maxsize=64)
+def _jitted_round(cfg: RaftConfig, spec: Spec):
+    """One traced+jitted round program per (cfg, spec), shared by every
+    RaftEngine. Re-jitting per engine instance re-traces the whole round
+    (~seconds of pjit tracing each) — at suite scale that tracing, not
+    execution, dominated wall time."""
+    return jax.jit(build_round(cfg, spec))
+
+
 class RaftEngine:
     """Jitted lockstep driver for a fleet of C x M-member Raft groups."""
 
@@ -264,7 +286,7 @@ class RaftEngine:
         )
         self.inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
         self.keep_mask = jnp.ones((spec.M, spec.M, C), jnp.bool_)
-        self._round = jax.jit(build_round(cfg, spec))
+        self._round = _jitted_round(cfg, spec)
 
     # -- one lockstep round -------------------------------------------------
     def step(

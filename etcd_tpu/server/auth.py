@@ -137,20 +137,9 @@ class Permission:
     key: bytes
     range_end: bytes | None = None
 
-    def covers(self, key: bytes, range_end: bytes | None, write: bool) -> bool:
-        if write and self.perm_type == READ:
-            return False
-        if not write and self.perm_type == WRITE:
-            return False
-        lo, hi = self.key, self.range_end
-        want_hi = range_end if range_end is not None else key + b"\x00"
-        if hi is None:
-            hi = self.key + b"\x00"
-        elif hi == b"\x00":
-            hi = b"\xff" * 64
-        if want_hi == b"\x00":
-            want_hi = b"\xff" * 64
-        return lo <= key and want_hi <= hi
+    # coverage checks live on the unified per-user interval trees
+    # (AuthStore._perm_cache), not per-permission — the reference's
+    # range_perm_cache merges abutting grants before checking
 
 
 @dataclasses.dataclass
@@ -186,6 +175,9 @@ class AuthStore:
         self.revision = 1
         self.users: dict[str, User] = {}
         self.roles: dict[str, Role] = {}
+        # (user, write?) -> (auth_revision, unified interval tree) — the
+        # rangePermCache analog, invalidated by revision movement
+        self._perm_trees: dict = {}
         # token -> (username, auth_revision, expiry_tick)  [simple provider]
         self.tokens: dict[str, tuple[str, int, int]] = {}
         self.now = 0
@@ -362,6 +354,7 @@ class AuthStore:
             for n, perms in snap["roles"].items()
         }
         self.tokens.clear()
+        self._perm_trees.clear()
 
     # -- authn (simple token provider) ---------------------------------------
     def authenticate(self, name: str, password: str) -> str:
@@ -408,14 +401,47 @@ class AuthStore:
             raise ErrUserNotFound(name)
         if self.ROOT_ROLE in u.roles:
             return
-        for rname in u.roles:
+        tree = self._perm_cache(name, write)
+        want = self._req_interval(key, range_end)
+        # checkKeyInterval over UNIFIED ranges (range_perm_cache.go:
+        # 104-120): a request spanning several abutting grants passes —
+        # per-permission containment would wrongly deny it
+        if tree.contains(want):
+            return
+        raise ErrPermissionDenied(name)
+
+    @staticmethod
+    def _req_interval(key: bytes, range_end):
+        from etcd_tpu.utils import adt
+
+        if range_end is None:
+            return adt.point(key)
+        if range_end == b"\x00":
+            return adt.Interval(key, adt.INF)
+        return adt.Interval(key, range_end)
+
+    def _perm_cache(self, name: str, write: bool):
+        """Per-(user, op) unified interval tree, rebuilt when the auth
+        revision moves (rangePermCache + invalidation on any auth
+        mutation, range_perm_cache.go:24-60)."""
+        from etcd_tpu.utils import adt
+
+        cached = self._perm_trees.get((name, write))
+        if cached is not None and cached[0] == self.revision:
+            return cached[1]
+        tree = adt.IntervalTree()
+        u = self.users.get(name)
+        want = WRITE if write else READ
+        for rname in (u.roles if u else ()):
             r = self.roles.get(rname)
             if r is None:
                 continue
             for p in r.perms:
-                if p.covers(key, range_end, write):
-                    return
-        raise ErrPermissionDenied(name)
+                if p.perm_type != READWRITE and p.perm_type != want:
+                    continue
+                tree.insert(self._req_interval(p.key, p.range_end), p)
+        self._perm_trees[(name, write)] = (self.revision, tree)
+        return tree
 
     def is_admin(self, token: str) -> None:
         if not self.enabled:

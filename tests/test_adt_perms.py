@@ -1,0 +1,111 @@
+"""pkg/adt interval tree + the auth unified-range permission cache.
+
+adt: interval semantics (affine INF end, point intervals), insert/
+delete/find/visit/intersects, and the union-coverage query
+(interval_tree.go Contains over unified ranges).
+
+auth: the range_perm_cache parity case the old per-permission check got
+wrong — a request spanning two ABUTTING grants must pass, because the
+reference checks against merged ranges (range_perm_cache.go:104-120).
+"""
+import pytest
+
+from etcd_tpu.server.auth import (
+    READ,
+    READWRITE,
+    WRITE,
+    AuthStore,
+    ErrPermissionDenied,
+    Permission,
+)
+from etcd_tpu.utils import adt
+
+
+def test_interval_basics():
+    ivl = adt.Interval(b"a", b"c")
+    assert adt.point(b"k") == adt.Interval(b"k", b"k\x00")
+    with pytest.raises(ValueError):
+        adt.Interval(b"c", b"a")
+    inf = adt.Interval(b"a", adt.INF)
+    assert inf.end is adt.INF
+    assert ivl.begin == b"a"
+
+
+def test_tree_insert_find_delete_visit():
+    t = adt.IntervalTree()
+    t.insert(adt.Interval(b"a", b"c"), 1)
+    t.insert(adt.Interval(b"b", b"d"), 2)
+    t.insert(adt.Interval(b"x", adt.INF), 3)
+    assert len(t) == 3
+    assert t.find(adt.Interval(b"b", b"d")) == 2
+    assert t.find(adt.Interval(b"b", b"e")) is None
+    seen = []
+    t.visit(adt.Interval(b"b", b"c"), lambda s, v: seen.append(v))
+    assert sorted(seen) == [1, 2]
+    assert t.intersects(adt.point(b"zzz"))  # inside [x, INF)
+    assert not t.intersects(adt.Interval(b"d", b"e"))
+    assert t.delete(adt.Interval(b"a", b"c"))
+    assert not t.delete(adt.Interval(b"a", b"c"))
+    assert len(t) == 2
+
+
+def test_union_coverage():
+    t = adt.IntervalTree()
+    t.insert(adt.Interval(b"a", b"c"))
+    t.insert(adt.Interval(b"c", b"e"))   # abutting
+    t.insert(adt.Interval(b"f", b"h"))   # gap at [e, f)
+    assert t.contains(adt.Interval(b"a", b"e"))      # spans the merge
+    assert t.contains(adt.Interval(b"b", b"d"))
+    assert not t.contains(adt.Interval(b"a", b"g"))  # crosses the gap
+    assert not t.contains(adt.Interval(b"e", b"f"))
+    assert t.union() == [adt.Interval(b"a", b"e"), adt.Interval(b"f", b"h")]
+    t.insert(adt.Interval(b"e", b"f"))
+    assert t.contains(adt.Interval(b"a", b"h"))      # gap closed
+
+
+def _store_with(perms):
+    a = AuthStore()
+    a.user_add("root", "pw")
+    a.role_add("root")
+    a.user_grant_role("root", "root")
+    a.user_add("u", "pw")
+    a.role_add("r")
+    for p in perms:
+        a.role_grant_permission("r", p)
+    a.user_grant_role("u", "r")
+    a.auth_enable()
+    return a
+
+
+def test_auth_unified_ranges_allow_spanning_request():
+    a = _store_with([
+        Permission(READ, b"a", b"c"),
+        Permission(READ, b"c", b"e"),
+    ])
+    # the reference merges [a,c)+[c,e) -> [a,e): the spanning range reads
+    a.check_user("u", b"a", b"e", write=False)
+    a.check_user("u", b"b", None, write=False)
+    with pytest.raises(ErrPermissionDenied):
+        a.check_user("u", b"a", b"f", write=False)
+    with pytest.raises(ErrPermissionDenied):
+        a.check_user("u", b"a", b"c", write=True)  # READ grant only
+
+
+def test_auth_perm_cache_invalidates_on_revision():
+    a = _store_with([Permission(READWRITE, b"k", None)])
+    a.check_user("u", b"k", None, write=True)
+    a.role_revoke_permission("r", b"k", None)
+    with pytest.raises(ErrPermissionDenied):
+        a.check_user("u", b"k", None, write=True)
+
+
+def test_auth_open_ended_and_write_grants():
+    a = _store_with([
+        Permission(WRITE, b"w", b"\x00"),   # [w, INF)
+        Permission(READ, b"r", None),       # point
+    ])
+    a.check_user("u", b"zzz", None, write=True)
+    a.check_user("u", b"w", b"\x00", write=True)
+    a.check_user("u", b"r", None, write=False)
+    with pytest.raises(ErrPermissionDenied):
+        a.check_user("u", b"zzz", None, write=False)  # WRITE-only grant
