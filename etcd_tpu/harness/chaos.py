@@ -29,6 +29,8 @@ no host round-trips during a chaos epoch.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -109,30 +111,34 @@ def build_chaos_epoch(
     cfg: RaftConfig,
     spec: Spec,
     rounds: int,
-    drop_p: float = 0.02,
-    delay_p: float = 0.05,
-    partition_p: float = 0.1,
+    faultless: bool = False,
     partition_period: int = 25,
     tick: bool = True,
 ):
     """One jitted chaos epoch: `rounds` lockstep rounds of faulted traffic
     with per-round invariant checks.
 
-    Returns fn(state, inbox, held, key, prop_len, prop_data, viol)
-    -> (state, inbox, held, key, viol, commits_delta). The regression
+    Returns fn(state, inbox, held, key, prop_len, prop_data, viol,
+    drop_p, delay_p, partition_p) -> (state, inbox, held, key, viol,
+    commits_delta). The fault probabilities are RUNTIME operands, not
+    closure constants — one traced program serves every fault mix (a
+    full trace costs ~40s of single-core time; the suite's three chaos
+    configurations used to pay it three times over). The regression
     baseline (prev_commit) starts at the entry state's own commit —
     nothing moves between epochs, so passing it across the boundary
     would merely alias a leaf of the donated state.
 
     Partitions re-sample every `partition_period` rounds: each group is
     partitioned with probability partition_p into two random sides (links
-    across sides drop entirely); other faults stack on top.
+    across sides drop entirely); other faults stack on top. `faultless`
+    selects the structurally-reduced heal program (no sampling, no held
+    bookkeeping), which ignores the probability operands.
     """
     round_fn = build_round(cfg, spec)
     M = spec.M
-    faultless = drop_p == 0.0 and delay_p == 0.0 and partition_p == 0.0
 
-    def epoch(state, inbox, held, key, prop_len, prop_data, viol):
+    def epoch(state, inbox, held, key, prop_len, prop_data, viol,
+              drop_p, delay_p, partition_p):
         prev_commit = state.commit
         C = state.term.shape[-1]
         zp = jnp.zeros((M, spec.E, C), jnp.int32)
@@ -203,6 +209,22 @@ def build_chaos_epoch(
     return epoch
 
 
+@functools.lru_cache(maxsize=32)
+def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
+                   faultless: bool):
+    """One jitted epoch program per (cfg, spec, rounds, structure),
+    shared across every run_chaos call and fault mix (probabilities are
+    operands). Donation of the fleet-sized carries (state/inbox/held) is
+    accelerator-only: large-C runs that compile fine otherwise die at
+    runtime allocation from double-buffering, while host runs don't need
+    the memory and keep maximum runtime portability."""
+    donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+    return jax.jit(
+        build_chaos_epoch(cfg, spec, rounds, faultless=faultless),
+        donate_argnums=donate,
+    )
+
+
 def run_chaos(
     spec: Spec,
     cfg: RaftConfig,
@@ -234,25 +256,23 @@ def run_chaos(
         prop_len = prop_len.at[0].set(1)
         prop_data = prop_data.at[0, 0].set(7)
 
-    # donate the fleet-sized carries (state/inbox/held): without this the
-    # epochs double-buffer the whole resident fleet and large-C runs that
-    # compile fine die at runtime allocation
-    chaos = jax.jit(build_chaos_epoch(
-        cfg, spec, epoch_len, drop_p, delay_p, partition_p
-    ), donate_argnums=(0, 1, 2))
-    heal = jax.jit(build_chaos_epoch(cfg, spec, heal_len, 0.0, 0.0, 0.0),
-                   donate_argnums=(0, 1, 2))
+    chaos = _epoch_program(cfg, spec, epoch_len, False)
+    heal = _epoch_program(cfg, spec, heal_len, True)
+    dp = jnp.float32(drop_p)
+    lp = jnp.float32(delay_p)
+    pp = jnp.float32(partition_p)
+    z = jnp.float32(0.0)
 
     viol = zero_violations()
     commits = []
     done = 0
     while done < rounds:
         state, inbox, held, key, viol, dc = chaos(
-            state, inbox, held, key, prop_len, prop_data, viol
+            state, inbox, held, key, prop_len, prop_data, viol, dp, lp, pp
         )
         done += epoch_len
         state, inbox, held, key, viol, dh = heal(
-            state, inbox, held, key, prop_len, prop_data, viol
+            state, inbox, held, key, prop_len, prop_data, viol, z, z, z
         )
         done += heal_len
         commits.append((int(dc), int(dh)))
@@ -268,7 +288,7 @@ def run_chaos(
         if leaders() == C:
             break
         state, inbox, held, key, viol, dh = heal(
-            state, inbox, held, key, prop_len, prop_data, viol
+            state, inbox, held, key, prop_len, prop_data, viol, z, z, z
         )
         done += heal_len
         commits.append((0, int(dh)))
