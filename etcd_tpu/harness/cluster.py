@@ -35,14 +35,16 @@ class Cluster:
     ):
         spec = spec or Spec(M=n_members)
         # canonical lane padding: each distinct C value re-traces the whole
-        # jitted round (~30s+ of pjit tracing on the test VM), so every
-        # cluster up to 16 lanes shares ONE 16-lane program per
+        # jitted round (~30s+ of pjit tracing on the test VM), so small
+        # MULTI-cluster tests (2..16 lanes) share one 16-lane program per
         # (cfg, spec); the extra lanes stay idle followers (never hupped
-        # or ticked — execution cost on the tiny test shapes is dispatch-
-        # bound, not element-bound) and every accessor below indexes an
-        # explicit c < self.C
+        # or ticked) and every accessor below indexes an explicit
+        # c < self.C. C=1 stays unpadded: single-cluster fleets are the
+        # overwhelmingly common case (every EtcdCluster), their programs
+        # already exist for every cfg, and step-loop-heavy server tests
+        # execute a 1-lane round measurably faster than a 16-lane one.
         self.C = C
-        self._Cp = 16 if C <= 16 else C
+        self._Cp = C if C <= 1 else (16 if C <= 16 else C)
         if voters is not None:
             voters = jnp.asarray(voters, jnp.bool_)
             if voters.ndim == 2 and voters.shape[0] != self._Cp:
@@ -227,7 +229,14 @@ class Cluster:
     # -- inspection ----------------------------------------------------------
     @property
     def s(self):
-        return self.eng.state
+        """State view restricted to the REAL lanes: whole-leaf reductions
+        in tests (min/all over the clusters axis) must not see the idle
+        canonical-padding lanes."""
+        if self._Cp == self.C:
+            return self.eng.state
+        import jax
+
+        return jax.tree.map(lambda x: x[..., : self.C], self.eng.state)
 
     def np_(self, leaf) -> np.ndarray:
         return np.asarray(leaf)
