@@ -23,7 +23,7 @@ import jax.numpy as jnp
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-# the unrolled round program is compile-heavy (minutes per (Spec, C) shape);
+# the fleet round program is compile-heavy (minutes per (Spec, C) shape);
 # persist compilations so repeated bench runs start hot
 os.makedirs(os.path.join(os.path.dirname(__file__) or ".", ".jax_cache"),
             exist_ok=True)
@@ -64,24 +64,13 @@ def main() -> None:
     # K=2 message slots: in the no-tick steady state each follower sees one
     # MsgApp per round (appends double as heartbeats, exactly the
     # reference's design point of ~1000 writes between 100ms ticks,
-    # server/etcdserver/raft.go:33-38). unroll_messages: the lax.scan
-    # while-loop costs ~10-25ms of fixed runtime per message on TPU, so the
-    # perf path runs the straight-line unrolled round program.
+    # server/etcdserver/raft.go:33-38).
     # BENCH_L trims the log ring for the 1M-group configuration: state is
     # ring-dominated (~3KB/cluster at L=32), and the steady state needs
     # only enough ring for the commit->apply pipeline (L > 2E + lag).
     L = int(os.environ.get("BENCH_L", "16"))
     W = int(os.environ.get("BENCH_W", "4"))
     spec = Spec(M=5, L=L, E=1, K=2, W=W, R=2, A=2)
-    # Default to the lax.scan round program. Profiling the unrolled
-    # variant on hardware (bench_trace) showed its compile-memory fix
-    # (per-step optimization barriers) shatters the round into ~13k
-    # unfusable small ops whose fixed per-op runtime overhead dominates;
-    # the scan form runs the same math with ~13 while iterations per
-    # round, and since per-round overhead is independent of C the
-    # throughput path is batch scale, not unrolling. BENCH_UNROLL=1
-    # opts back into the unrolled program.
-    unroll = os.environ.get("BENCH_UNROLL", "0") != "0"
     # inbox_bound=M-1: lossless in the one-proposal-per-round steady state
     # (leader sees M-1 acks, followers 1 append; see RaftConfig.inbox_bound
     # and tests/test_inbox_compaction.py), and cuts the dominant serial
@@ -97,7 +86,7 @@ def main() -> None:
     # wire value stays far below 32768 — see RaftConfig.wire_int16)
     wire16 = os.environ.get("BENCH_WIRE16", "1" if on_accel else "0") != "0"
     cfg = RaftConfig(pre_vote=True, check_quorum=True,
-                     unroll_messages=unroll, max_inflight=min(4, W),
+                     max_inflight=min(4, W),
                      inbox_bound=bound, coalesce_commit_refresh=True,
                      fleet_chunks=chunks, wire_int16=wire16)
     M, E = spec.M, spec.E
@@ -189,10 +178,7 @@ def main() -> None:
         metrics_report,
         zero_metrics,
     )
-    import dataclasses as _dc
-
-    met_cfg = _dc.replace(cfg, unroll_messages=False)
-    met_step = jax.jit(build_metered_round(met_cfg, spec),
+    met_step = jax.jit(build_metered_round(cfg, spec),
                        donate_argnums=(0, 1))
     metrics = zero_metrics()
     mrounds = 8

@@ -131,13 +131,19 @@ class Cluster:
 
     # -- stepping ------------------------------------------------------------
     def step(self, tick: bool = False):
+        # padding lanes never tick: a broadcast scalar would run
+        # elections/heartbeats on the idle canonical lanes, generating
+        # traffic that _pending() would then count
+        do_tick = np.zeros((self.spec.M, self._Cp), bool)
+        if tick:
+            do_tick[:, : self.C] = True
         self.eng.step(
             prop_len=self._plen,
             prop_data=self._pdata,
             prop_type=self._ptype,
             ri_ctx=self._rictx,
             do_hup=self._hup,
-            do_tick=tick,
+            do_tick=do_tick,
         )
         self._reset_inputs()
 
@@ -145,12 +151,17 @@ class Cluster:
         for _ in range(rounds):
             self.step(tick=True)
 
+    def _pending(self) -> int:
+        """Pending messages over the REAL lanes only — padding-lane
+        traffic must not keep stabilize spinning."""
+        return int((np.asarray(self.eng.inbox.type)[..., : self.C] != 0).sum())
+
     def stabilize(self, max_rounds: int = 64, tick: bool = False):
         """Deliver cascades to quiescence (network.send's loop-to-empty,
         raft_test.go:4713-4720)."""
         self.step(tick=tick)
         for _ in range(max_rounds):
-            if self.eng.pending_messages() == 0:
+            if self._pending() == 0:
                 break
             self.step(tick=tick)
         return self

@@ -36,8 +36,10 @@ class RecordingStorage(Storage):
     def initial_state(self):
         return self._do("initial_state")
 
-    def entries(self, lo, hi, max_size=None):
-        return self._do("entries", lo, hi)
+    def entries(self, lo, hi, max_entries=None):
+        # forward the limit: a wrapped storage's size-limited reads must
+        # behave identically under recording
+        return self._do("entries", lo, hi, max_entries=max_entries)
 
     def term(self, i):
         return self._do("term", i)

@@ -183,11 +183,18 @@ class LeasingKV:
             )
             if res["succeeded"]:
                 mod = int(res["rev"])
-                prev = self.cache.get(key)
-                self.cache[key] = dataclasses.replace(
-                    prev, value=value, mod_revision=mod,
-                    version=prev.version + 1,
-                ) if prev is not None else _fresh_kv(key, value, mod)
+                if key in self.cache:
+                    # _fresh_kv only when the cache says "key absent"
+                    # (entry is None); an unknown entry (e.g. txn()
+                    # invalidated it) stays unpopulated — fabricating
+                    # create_revision/version=1 for a pre-existing key
+                    # would poison later cached gets. get() reads
+                    # through on a missing entry.
+                    prev = self.cache[key]
+                    self.cache[key] = dataclasses.replace(
+                        prev, value=value, mod_revision=mod,
+                        version=prev.version + 1,
+                    ) if prev is not None else _fresh_kv(key, value, mod)
                 return res
             # lost the claim mid-flight: a NEW claimant may own the key
             # now, so fall through to the full revoke protocol — a bare

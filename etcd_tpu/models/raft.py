@@ -10,13 +10,14 @@ arrays, [L] log ring) and batched by ``jax.vmap`` over the member and
 cluster axes; data-dependent Go control flow becomes ``jnp.where`` masks so
 the whole round jits into one fused XLA program.
 
-Message processing is an UNROLLED loop over the (statically bounded)
-per-round sequence [hup, inbox(M*K), prop, read-index] — on TPU a
-``lax.scan`` pays a large fixed runtime cost per while-loop iteration that
-dwarfs the body's compute at fleet shapes, while unrolling compiles the
-whole round into one straight-line fused program (compile time is paid
-once per (Spec, C) shape and persisted in the compile cache). The apply
-loop of length Spec.A is unrolled for the same reason.
+Message processing is a ``lax.scan`` over the (statically bounded)
+per-round sequence [hup, inbox(M*K or inbox_bound), prop, read-index].
+A straight-line unroll was measured and removed: the per-step
+optimization barriers it needed to bound peak HBM shattered the round
+into ~13k unfusable ops (fixed per-op overhead dominated on TPU), and the
+unrolled XLA CPU compile was pathological (>6GB RSS at C=1). The scan
+runs the same masked math one while-iteration per slot; throughput comes
+from batch scale C, and the serial slot count from inbox compaction.
 
 Deviations from the reference, all intentional and documented inline:
   * The application is fused: committed entries (and snapshots/conf
@@ -1296,13 +1297,7 @@ def apply_round(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox):
         )
         return (n, ob), None
 
-    if cfg.unroll_messages:
-        # see node_round: while-loop iterations carry large fixed runtime
-        # overhead on TPU; A is small and static
-        for _ in range(spec.A):
-            (n, ob), _ = body((n, ob), None)
-    else:
-        (n, ob), _ = jax.lax.scan(body, (n, ob), None, length=spec.A)
+    (n, ob), _ = jax.lax.scan(body, (n, ob), None, length=spec.A)
 
     # auto-leave joint config (advance(), raft.go:554-570)
     al = (
@@ -1422,29 +1417,19 @@ def node_round(
     )
     if cfg.inbox_bound:
         flat = compact_inbox(spec, flat, cfg.inbox_bound)
-    n_slots = flat.type.shape[0]
-    if cfg.unroll_messages:
-        # Unrolled message loop: a lax.scan costs one while-loop iteration
-        # of fixed runtime overhead (~10-25ms measured on the TPU runtime)
-        # per message. The sequence is short and statically bounded
-        # (M*K), so straight-line unrolling lets XLA fuse across messages.
-        #
-        # The optimization barrier between steps bounds peak HBM: without
-        # it the scheduler keeps every step's big intermediates (the
-        # one-hot ring-roll matrices are O(L^2 * C)) live at once and the
-        # unrolled program OOMs at fleet C (observed 37G at C=8k); the
-        # barrier makes step i's scratch die before step i+1 allocates.
-        for i in range(n_slots):
-            m = jax.tree.map(lambda x: x[i], flat)
-            n, ob = process_message(cfg, spec, n, ob, m)
-            n, ob = jax.lax.optimization_barrier((n, ob))
-    else:
-        def body(carry, m):
-            nn, oo = carry
-            nn, oo = process_message(cfg, spec, nn, oo, m)
-            return (nn, oo), None
+    # Scan the message slots. A straight-line unroll was tried (rounds 1-3)
+    # and removed: on TPU the per-step optimization barriers it needed to
+    # bound peak HBM shattered the round into ~13k unfusable ops whose fixed
+    # per-op overhead dominated (bench.py history), and on XLA CPU the
+    # unrolled compile was pathological (>6GB compile RSS even at C=1,
+    # SIGSEGV in the full suite). The scan form runs the same math with one
+    # while iteration per slot; the throughput lever is batch scale C.
+    def body(carry, m):
+        nn, oo = carry
+        nn, oo = process_message(cfg, spec, nn, oo, m)
+        return (nn, oo), None
 
-        (n, ob), _ = jax.lax.scan(body, (n, ob), flat)
+    (n, ob), _ = jax.lax.scan(body, (n, ob), flat)
 
     n, ob = process_message(cfg, spec, n, ob, prop_msg)
     n, ob = process_message(cfg, spec, n, ob, ri_msg)

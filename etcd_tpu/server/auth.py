@@ -402,7 +402,12 @@ class AuthStore:
         if self.ROOT_ROLE in u.roles:
             return
         tree = self._perm_cache(name, write)
-        want = self._req_interval(key, range_end)
+        try:
+            want = self._req_interval(key, range_end)
+        except ValueError:
+            # degenerate request range (range_end <= key): nothing can
+            # grant it — deny, don't propagate adt's construction error
+            raise ErrPermissionDenied(name)
         # checkKeyInterval over UNIFIED ranges (range_perm_cache.go:
         # 104-120): a request spanning several abutting grants passes —
         # per-permission containment would wrongly deny it
@@ -439,7 +444,13 @@ class AuthStore:
             for p in r.perms:
                 if p.perm_type != READWRITE and p.perm_type != want:
                     continue
-                tree.insert(self._req_interval(p.key, p.range_end), p)
+                try:
+                    tree.insert(self._req_interval(p.key, p.range_end), p)
+                except ValueError:
+                    # a degenerate stored grant (role_grant_permission
+                    # does no validation) must not break every authz
+                    # check for the user — it simply grants nothing
+                    continue
         self._perm_trees[(name, write)] = (self.revision, tree)
         return tree
 

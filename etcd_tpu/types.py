@@ -162,8 +162,12 @@ class Msg(struct.PyTreeNode):
 ENT_FIELDS = ("ent_term", "ent_data", "ent_type")
 
 
+# [epoch, strong ref to the client the epoch was minted for] — see empty_msg
+_backend_epoch: list = [0, None]
+
+
 @functools.lru_cache(maxsize=64)
-def _empty_msg(spec: Spec, backend: str) -> Msg:
+def _empty_msg(spec: Spec, backend_key: int) -> Msg:
     z = jnp.int32(0)
     return Msg(
         type=z, term=z, frm=jnp.int32(NONE_ID), index=z, log_term=z,
@@ -180,11 +184,18 @@ def empty_msg(spec: Spec) -> Msg:
     """Cached per (spec, active backend): Msg leaves are immutable and
     every caller builds variants via ``.replace``, so sharing the
     template saves ~17 device-scalar creations per host-bridged message.
-    The backend key keeps a platform switch (e.g. dryrun_multichip's
-    clear_backends) from handing out arrays bound to a dead backend."""
+    The key is a backend EPOCH (bumped whenever the live client object
+    changes, compared by identity against a strong reference): a platform
+    NAME would alias a re-initialised platform with its torn-down
+    predecessor, and a bare id() could be reused by the allocator after
+    the old client is collected."""
     import jax
 
-    return _empty_msg(spec, jax.default_backend())
+    client = jax.devices()[0].client
+    if client is not _backend_epoch[1]:
+        _backend_epoch[0] += 1
+        _backend_epoch[1] = client
+    return _empty_msg(spec, _backend_epoch[0])
 
 
 def pack_mask(mask: jnp.ndarray) -> jnp.ndarray:

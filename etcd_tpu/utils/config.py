@@ -28,13 +28,6 @@ class RaftConfig:
     read_only_lease_based: bool = False
     # raft.Config.DisableProposalForwarding
     disable_proposal_forwarding: bool = False
-    # Unroll the per-round message loop into straight-line XLA instead of a
-    # lax.scan. On TPU each while-loop iteration carries a large fixed
-    # runtime cost, so unrolling is ~20x faster per round at fleet shapes;
-    # the price is a ~(M*K)x larger graph and correspondingly slower first
-    # compile, which is wrong for the (CPU, many-Spec) test suite. Perf
-    # paths (bench, entry) turn this on.
-    unroll_messages: bool = False
     # Compact each node's inbox (nonempty slots to the front, original
     # order preserved) and process only the first `inbox_bound` slots per
     # round instead of all M*K. Messages past the bound are DROPPED —

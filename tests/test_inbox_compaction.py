@@ -166,13 +166,8 @@ def test_bounded_election_still_converges():
     assert ok, "bounded inbox wedged leader election"
 
 
-def test_bound_applies_under_unroll():
-    spec = Spec(M=3, L=16, E=1, K=2, W=2, R=2, A=2)
-    cfg = RaftConfig(inbox_bound=2, unroll_messages=True)
-    cl = Cluster(n_members=3, spec=spec, cfg=cfg)
-    cl.campaign(0)
-    cl.stabilize()
-    assert cl.leader() == 0
-    cl.propose(0, 5)
-    cl.stabilize()
-    assert cl.commits().tolist() == [2, 2, 2]
+# NOTE: the straight-line `unroll_messages` round variant was deleted in
+# round 4 — its XLA CPU compile was pathological (>6GB RSS / SIGSEGV even at
+# C=1) and the TPU bench had already abandoned it for the scan program
+# (models/raft.py node_round). Bound semantics under the scan path are
+# covered by the tests above.

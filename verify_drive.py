@@ -1,0 +1,105 @@
+"""End-to-end verify drive (see .claude/skills/verify): library surface +
+this round's changed paths (leasing cache-miss put, degenerate auth grants,
+padded-lane stabilize, scan-only round program)."""
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+from etcd_tpu.embed import EtcdCluster
+from etcd_tpu.client import Client, Op
+from etcd_tpu.concurrency import Mutex, Election, Session
+from etcd_tpu.leasing import LeasingKV
+
+ec = EtcdCluster(n_members=3)
+c = Client(ec)
+
+# KV + watch + lease
+c.put(b"k1", b"v1")
+assert c.get(b"k1").value == b"v1"
+w = c.watch_prefix(b"k")
+c.put(b"k2", b"v2")
+evs = w.events()
+assert any(e.kv.key == b"k2" for e in evs), evs
+lid = c.lease_grant(777, 60)
+c.put(b"lk", b"lv", lease=777)
+assert c.get(b"lk") is not None
+
+# concurrency
+s = Session(c, ttl=60)
+m = Mutex(s, b"mu")
+m.lock()
+m.unlock()
+e = Election(s, b"el")
+e.campaign(b"leader-a")
+assert e.leader().value == b"leader-a"
+
+# leasing: the ADVICE-medium path — txn() invalidates the cache entry for an
+# owned pre-existing key; the next owned put must NOT fabricate
+# create_revision/version=1, and the next get must serve the true ones
+lkv = LeasingKV(c, b"_lease")
+lkv.put(b"key-x", b"v0")          # not owned yet -> plain put
+kv0 = lkv.get(b"key-x")           # acquires ownership + caches
+assert kv0.value == b"v0"
+create0, ver0 = kv0.create_revision, kv0.version
+lkv.txn().then(Op("put", b"key-x", b"v1")).commit()  # invalidates cache
+res = lkv.put(b"key-x", b"v2")    # owned put on unknown cache entry
+kv2 = lkv.get(b"key-x")
+assert kv2.value == b"v2", kv2
+assert kv2.create_revision == create0, (kv2.create_revision, create0)
+assert kv2.version > ver0, (kv2.version, ver0)
+print("leasing cache-miss put: create_revision preserved "
+      f"({create0} -> {kv2.create_revision}), version {ver0} -> {kv2.version}")
+
+# auth: degenerate stored grant must not break authz; degenerate request
+# range must deny, not raise ValueError
+from etcd_tpu.server.auth import AuthStore, ErrPermissionDenied, Permission, READ
+au = AuthStore()
+au.user_add("root", "pw")
+au.role_add("root")
+au.user_grant_role("root", "root")
+au.user_add("alice", "pw")
+au.role_add("r1")
+au.role_grant_permission("r1", Permission(READ, b"b", b"a"))  # degenerate
+au.role_grant_permission("r1", Permission(READ, b"k", b"l"))  # real
+au.user_grant_role("alice", "r1")
+au.auth_enable()
+au.check_user("alice", b"k")                        # real grant still works
+try:
+    au.check_user("alice", b"z", b"a")              # degenerate request
+    raise SystemExit("degenerate request range was ALLOWED")
+except ErrPermissionDenied:
+    pass
+print("auth degenerate grant/request: denied cleanly, real grants intact")
+
+# faults + corruption check
+lead = next(m for m in range(3) if ec.cl.leader() == m)
+follower = (lead + 1) % 3
+ec.cl.isolate(follower)   # quorum of 2 keeps committing
+c.put(b"k3", b"v3")
+assert c.get(b"k3").value == b"v3"
+ec.cl.recover()
+for _ in range(8):
+    ec.cl.step(tick=True)
+ec.corruption_check()
+print("fault + corruption check OK")
+
+# padded-lane stabilize: a 3-lane fleet pads to 16; stabilize must converge
+# (padding lanes untic­ked) and see real-lane traffic only
+from etcd_tpu.harness.cluster import Cluster
+cl = Cluster(3, C=3)
+for i in range(3):
+    cl.campaign(0, c=i)
+cl.stabilize()
+assert all(cl.leader(c) == 0 for c in range(3))
+cl.tick(12)  # ticks only real lanes now
+assert cl._pending() == 0 or cl.stabilize() is cl
+print("padded-lane harness OK (leaders:", [cl.leader(c) for c in range(3)], ")")
+
+print("VERIFY DRIVE PASSED")
