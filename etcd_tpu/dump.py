@@ -84,6 +84,37 @@ def dump_logs(wal_dir: str) -> dict:
     }
 
 
+def dump_metrics(ec=None) -> list[str]:
+    """tools/etcd-dump-metrics analog: enumerate the full metrics
+    exposition of a (fresh, if none given) cluster — the reference tool
+    boots an etcd instance and scrapes /metrics to document every metric
+    name with a default value."""
+    from etcd_tpu.models.metrics import fleet_summary
+
+    if ec is None:
+        from etcd_tpu.server.kvserver import EtcdCluster
+
+        ec = EtcdCluster(n_members=1)  # in-process; no teardown needed
+    s = fleet_summary(ec.cl.s)
+    flat: dict = {}
+    for k, v in s.items():
+        if isinstance(v, dict):  # e.g. roles -> roles_follower etc.
+            for k2, v2 in v.items():
+                flat[f"{k}_{k2}"] = v2
+        else:
+            flat[k] = v
+    lines = [f"etcd_tpu_{k} {v}" for k, v in sorted(flat.items())]
+    td = getattr(ec, "contention", None)
+    lines.append(
+        f"etcd_tpu_ticker_late_total {td.late_total if td else 0}"
+    )
+    lines.append(
+        "etcd_tpu_ticker_late_max_seconds "
+        f"{td.max_exceeded if td else 0.0:.6f}"
+    )
+    return lines
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="etcd-dump-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -100,7 +131,13 @@ def main(argv=None) -> int:
     lg = sub.add_parser("logs")
     lg.add_argument("wal_dir")
 
+    sub.add_parser("metrics")  # etcd-dump-metrics analog
+
     args = p.parse_args(argv)
+    if args.cmd == "metrics":
+        for line in dump_metrics():
+            print(line)
+        return 0
     if args.cmd == "db":
         if args.db_cmd == "list-bucket":
             for b in dump_db_buckets(args.path):

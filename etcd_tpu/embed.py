@@ -52,6 +52,11 @@ class Config:
     # restart from this data dir as a ONE-member cluster, discarding the
     # other members (bootstrap.go:327-341)
     force_new_cluster: bool = False
+    # cluster-version monitor cadence in ticks (monitorVersionInterval =
+    # 5s at the reference's 100ms tick, server.go:2160); 0 disables.
+    # The manual tick() path (tests) leaves monitoring to explicit
+    # monitor_versions() calls so tick counts stay deterministic.
+    monitor_version_ticks: int = 50
 
     def validate(self) -> None:
         if self.cluster_size < 1:
@@ -99,6 +104,12 @@ class Etcd:
         self.http = V3Server(
             self.server, cfg.listen_client_host, cfg.listen_client_port
         ).start()
+        # contention detector over the tick cadence (pkg/contention armed
+        # at 2x the interval, etcdserver/raft.go:133)
+        from etcd_tpu.utils.contention import TimeoutDetector
+
+        self.contention = TimeoutDetector(2 * cfg.tick_ms / 1000.0)
+        self.server.contention = self.contention
         self._stop = threading.Event()
         self._ticker: threading.Thread | None = None
         if cfg.auto_tick:
@@ -179,15 +190,35 @@ class Etcd:
         # and advance the lease clock once per elapsed second, whatever
         # the raft tick rate (sub-second or multi-second) is
         owed = 0.0
+        ticks = 0
+        mon_every = self.config.monitor_version_ticks
         while not self._stop.wait(period):
             owed += period
             advance = int(owed)
             owed -= advance
+            ticks += 1
+            on_time, exceed = self.contention.observe("tick")
+            if not on_time:
+                from etcd_tpu.utils.logging import get_logger
+
+                get_logger().warning(
+                    "ticker took %.3fs longer than expected; host loop "
+                    "contended (disk/CPU starvation)", exceed,
+                )
             with self.http.api.lock:
                 self.server.tick(lease_clock=advance >= 1)
                 for _ in range(advance - 1):  # tick_ms > 1000: catch up
                     self.server.advance_lease_clock()
                 self.compactor.tick()
+                if mon_every and ticks % mon_every == 0:
+                    # monitorVersions + monitorDowngrade passes (leader
+                    # only; no-ops otherwise). Proposal failures (lost
+                    # leadership mid-pass) are the next pass's problem.
+                    try:
+                        self.server.monitor_versions()
+                        self.server.monitor_downgrade()
+                    except Exception:
+                        pass
 
     def tick(self, n: int = 1) -> None:
         """Manual clock (auto_tick=False mode, for tests): each call is

@@ -137,6 +137,11 @@ def main(argv=None) -> int:
     au = sub.add_parser("auth")
     au.add_argument("auth_cmd", choices=("enable", "disable"))
 
+    # etcdctl downgrade validate/enable/cancel (ctlv3/command/downgrade.go)
+    dg = sub.add_parser("downgrade")
+    dg.add_argument("downgrade_cmd", choices=("validate", "enable", "cancel"))
+    dg.add_argument("target_version", nargs="?")
+
     us = sub.add_parser("user")
     usub = us.add_subparsers(dest="user_cmd", required=True)
     ua = usub.add_parser("add"); ua.add_argument("name"); ua.add_argument("password")
@@ -227,6 +232,13 @@ def main(argv=None) -> int:
         else:
             for l in ctl.call("/v3/lease/leases", {}).get("leases", []):
                 print(l["ID"])
+    elif c == "downgrade":
+        body = {"action": args.downgrade_cmd.upper()}
+        if args.target_version:
+            body["version"] = args.target_version
+        res = ctl.call("/v3/maintenance/downgrade", body)
+        print(f"cluster version {res['version']}; "
+              f"downgrade {args.downgrade_cmd} OK")
     elif c == "member":
         mc = args.member_cmd
         if mc == "add":

@@ -1,0 +1,208 @@
+"""Lease stress + expiry checking under faults — the host-layer chaos tier.
+
+The reference's functional tester stresses leases while faults fire
+(tests/functional/tester/stresser_lease.go: create leases with and without
+keepalives, attach keys) and then checks expiry semantics
+(tester/checker_lease_expire.go + checker_short_ttl_lease_expire.go):
+after waiting out the TTL, every lease that was NOT kept alive must be
+gone — with its attached keys deleted — and every kept-alive lease must
+survive with its keys intact. The device chaos tier (harness/chaos.py)
+covers raft safety at fleet scale; this tier drives the HOST layer
+(Lessor, revoke-through-consensus, MVCC deletes) through the same fault
+classes via the keep-mask, which nothing exercised before.
+
+Faults make individual requests fail (no leader / timeout) — like the
+reference tester, the stresser tolerates errors during fault epochs and
+the checker runs after heal, within a bounded slack (the checker's own
+retry loop, checker_lease_expire.go waitForLeaseExpire)."""
+from __future__ import annotations
+
+import numpy as np
+
+from etcd_tpu.server.kvserver import EtcdCluster, ServerError
+
+
+class _Rng:
+    def __init__(self, seed: int):
+        self.r = np.random.default_rng(seed)
+
+    def keep_mask(self, M: int, drop_p: float) -> np.ndarray:
+        km = self.r.random((M, M, 1)) >= drop_p
+        return km | np.eye(M, dtype=bool)[:, :, None]
+
+
+def run_lease_chaos(
+    n_members: int = 5,
+    n_leases: int = 8,
+    ttl: int = 4,
+    short_ttl: int = 1,
+    fault_rounds: int = 30,
+    drop_p: float = 0.25,
+    seed: int = 0,
+) -> dict:
+    """One stress/fault/heal/check cycle. Returns counters; the caller
+    asserts on ``violations`` (and chaos_run.py folds them into its JSON).
+
+    Leases [0, n//2) are kept alive through the fault epoch; leases
+    [n//2, n) and one short-TTL lease are abandoned and must expire with
+    their keys revoked. TTLs are seconds = lease-clock ticks here."""
+    import jax.numpy as jnp
+
+    ec = EtcdCluster(n_members=n_members, lease_min_ttl=1)
+    ec.ensure_leader()
+    rng = _Rng(seed)
+    M = ec.M
+
+    kept = list(range(1, n_leases // 2 + 1))
+    abandoned = list(range(n_leases // 2 + 1, n_leases + 1))
+    for lid in kept + abandoned:
+        ec.lease_grant(lid, ttl)
+        ec.put(b"lease-k-%d" % lid, b"v", lease=lid)
+    short_id = n_leases + 1
+    ec.lease_grant(short_id, short_ttl)  # checker_short_ttl analog
+    ec.put(b"lease-k-%d" % short_id, b"v", lease=short_id)
+
+    errors = 0
+    keepalive_ok = 0
+    # a kept lease whose renewals gapped >= TTL during the fault epoch may
+    # legally expire — the stresser failed, not the system. The reference
+    # checker likewise only asserts on leases its stresser could service.
+    last_renew = {lid: 0 for lid in kept}
+    indeterminate: set[int] = set()
+    # fault epoch: random link drops re-rolled every round while the lease
+    # clock advances and keepalives fight through the faults
+    for r in range(fault_rounds):
+        ec.cl.eng.keep_mask = jnp.asarray(rng.keep_mask(M, drop_p))
+        try:
+            ec.tick(lease_clock=True)
+        except ServerError:
+            errors += 1
+        if r % 2 == 0:
+            for lid in kept:
+                try:
+                    ec.lease_keepalive(lid)
+                    keepalive_ok += 1
+                    last_renew[lid] = r
+                except ServerError:
+                    errors += 1
+                    if r - last_renew[lid] >= ttl - 1:
+                        indeterminate.add(lid)
+
+    # heal, then give expiry the reference checker's slack: revokes that
+    # queued behind faults drain through consensus here. The stresser
+    # KEEPS renewing the kept set through the wait (the wait exists to
+    # expire the ABANDONED set; without renewals the kept leases would
+    # legitimately expire too and prove nothing).
+    ec.cl.recover()
+    for r in range(ttl + 6):
+        try:
+            ec.tick(lease_clock=True)
+        except ServerError:
+            errors += 1
+        if r % 2 == 0:
+            for lid in kept:
+                try:
+                    ec.lease_keepalive(lid)
+                except ServerError:
+                    errors += 1
+                    indeterminate.add(lid)
+
+    violations: list[str] = []
+    lead = ec.ensure_leader()
+    live = set(ec.leases())
+    for lid in kept:
+        if lid in indeterminate:
+            continue  # renewals gapped past TTL: expiry would be legal
+        # kept alive through the epoch, so renewed within TTL: must live
+        if lid not in live:
+            violations.append(f"kept lease {lid} expired")
+        elif ec.range(b"lease-k-%d" % lid)["count"] != 1:
+            violations.append(f"kept lease {lid} lost its key")
+    for lid in abandoned + [short_id]:
+        if lid in live:
+            violations.append(f"abandoned lease {lid} still alive")
+        elif ec.range(b"lease-k-%d" % lid)["count"] != 0:
+            violations.append(f"expired lease {lid} left its key behind")
+
+    return {
+        "lease_kept": len(kept),
+        "lease_kept_indeterminate": len(indeterminate),
+        "lease_abandoned": len(abandoned) + 1,
+        "lease_keepalives_ok": keepalive_ok,
+        "lease_request_errors": errors,
+        "lease_violations": violations,
+        "leader_after_heal": lead,
+    }
+
+
+def run_runner_chaos(
+    n_members: int = 3,
+    n_runners: int = 3,
+    fault_rounds: int = 20,
+    drop_p: float = 0.2,
+    seed: int = 1,
+) -> dict:
+    """Election-runner stress under faults (tester/stresser_runner.go,
+    which shells out to functional/runner's election-command): N
+    concurrency.Election candidates campaign/proclaim/resign against a
+    faulted cluster; mutual exclusion (never two holders at once) must
+    hold throughout, and after heal the election must make progress."""
+    import jax.numpy as jnp
+
+    from etcd_tpu.client import Client
+    from etcd_tpu.concurrency import ConcurrencyError, Election, Session
+
+    ec = EtcdCluster(n_members=n_members, lease_min_ttl=1)
+    ec.ensure_leader()
+    c = Client(ec)
+    rng = _Rng(seed)
+    sessions = [Session(c, ttl=60) for _ in range(n_runners)]
+    els = [Election(s, b"chaos-el") for s in sessions]
+
+    errors = 0
+    exclusion_violations = 0
+    leaders_seen: set[bytes] = set()
+    for r in range(fault_rounds):
+        ec.cl.eng.keep_mask = jnp.asarray(rng.keep_mask(ec.M, drop_p))
+        i = r % n_runners
+        try:
+            if els[i].is_leader():
+                els[i].proclaim(b"v%d" % r)
+                els[i].resign()
+            else:
+                els[i].campaign(b"runner-%d" % i, max_rounds=30)
+        except (ServerError, ConcurrencyError):
+            errors += 1
+        # mutual exclusion: by construction at most one lowest
+        # create-revision key exists; violation = two runners both
+        # believing they hold it. The observation itself is a
+        # linearizable read and may time out mid-fault — skip that
+        # round's check, as the reference checker retries around
+        # cluster unavailability.
+        try:
+            holders = [
+                j for j, e in enumerate(els) if e.my_rev and e.is_leader()
+            ]
+            if len(holders) > 1:
+                exclusion_violations += 1
+            lv = els[i].leader()
+            if lv is not None:
+                leaders_seen.add(bytes(lv.value))
+        except (ServerError, ConcurrencyError):
+            errors += 1
+    ec.cl.recover()
+    # post-heal progress: someone can win an election cleanly
+    for e in els:
+        try:
+            e.resign()
+        except (ServerError, ConcurrencyError):
+            pass
+    els[0].campaign(b"final", max_rounds=200)
+    final_ok = els[0].is_leader()
+    return {
+        "runner_count": n_runners,
+        "runner_errors": errors,
+        "runner_exclusion_violations": exclusion_violations,
+        "runner_leaders_seen": len(leaders_seen),
+        "runner_final_progress": bool(final_ok),
+    }

@@ -64,6 +64,39 @@ def to_wire(m: Msg) -> Msg:
     )
 
 
+def wire_overflow_count(spec: Spec, inbox: Msg) -> jnp.ndarray:
+    """Mechanical int16-wire safety check: count values in a flat int32
+    inbox ([from, K*to(*E), C] leaves) that would NOT survive the int16
+    cast and are not covered by a registered split (types.WIRE_SPLIT).
+
+    This is the test-time guard for the 81d0b1e bug class — MsgSnap's
+    32-bit applied hash riding `commit` was silently truncated by
+    RaftConfig.wire_int16 until the chaos KV_HASH checker caught the
+    divergence. Any new wide field on the wire now fails
+    tests/test_wire_safety.py instead of corrupting a fleet."""
+    from etcd_tpu.types import WIRE_SPLIT
+
+    if inbox.term.dtype == jnp.int16:
+        raise ValueError(
+            "wire_overflow_count audits the PRE-cast int32 wire; run the "
+            "fleet with wire_int16=False and check each round's inbox"
+        )
+    lo, hi = -(2 ** 15), 2 ** 15 - 1
+    t = inbox.type.astype(jnp.int32)  # [M, K*M, C]
+    total = jnp.zeros((), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    for name in Msg.__dataclass_fields__:
+        x = getattr(inbox, name)
+        if x.dtype != jnp.int32:
+            continue
+        tt = jnp.repeat(t, spec.E, axis=1) if name in _ENT_FIELDS else t
+        bad = (x < lo) | (x > hi)
+        for (f, msg_type) in WIRE_SPLIT:
+            if f == name:
+                bad = bad & (tt != msg_type)
+        total = total + bad.sum()
+    return total
+
+
 def from_wire(m: Msg) -> Msg:
     return jax.tree.map(
         lambda x: x.astype(jnp.int32) if x.dtype == jnp.int16 else x, m

@@ -121,6 +121,36 @@ def build_shard_map_round(cfg: RaftConfig, spec: Spec, mesh: Mesh):
     return jax.jit(fn)
 
 
+def build_global_invariants(cfg: RaftConfig, spec: Spec, mesh: Mesh):
+    """Fleet-wide safety counters over a SHARDED fleet without gathering
+    it: every device runs the chaos checker (harness/chaos.py
+    check_invariants — pure reductions over its local [M, ..., C/n]
+    cluster shard) and ONE scalar psum per counter crosses the mesh.
+    This is the cross-shard composition build_shard_map_round exists
+    for: per-shard math + a collective only at the invariant boundary,
+    so the ICI cost is 3 scalars per check instead of the fleet."""
+    from etcd_tpu.harness.chaos import check_invariants, zero_violations
+
+    st = jax.eval_shape(
+        lambda: init_fleet(spec, 2, election_tick=cfg.election_tick)
+    )
+    state_specs = jax.tree.map(_last_axis_p, st)
+
+    def local(state_shard, prev_commit_shard):
+        v = check_invariants(state_shard, prev_commit_shard,
+                             zero_violations())
+        return jax.tree.map(lambda x: jax.lax.psum(x, CLUSTER_AXIS), v)
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(state_specs, P(None, CLUSTER_AXIS)),
+        out_specs=jax.tree.map(lambda _: P(), zero_violations()),
+        check_rep=False,
+    )
+    return jax.jit(fn)
+
+
 def build_scan_rounds(cfg: RaftConfig, spec: Spec, mesh: Mesh | None, rounds: int,
                       use_shard_map: bool = False):
     """Fixed-schedule driver: scan `rounds` lockstep rounds entirely on

@@ -139,11 +139,68 @@ class WatchCoalescer:
         return False
 
 
+class LeaseCoalescer:
+    """Lease keepalive fan-in (grpcproxy/lease.go leaseProxy + clientv3's
+    lessor, which multiplexes every local keeper of a lease onto ONE
+    upstream keepalive stream): N proxy clients refreshing the same lease
+    collapse onto one upstream keepalive per refresh interval. The
+    interval follows clientv3's send rule (TTL/3, lease.go keepAliveLoop):
+    a keepalive answered within it is served from the cached response
+    without touching the upstream."""
+
+    MAX_ENTRIES = 4096  # oldest-entry eviction; naturally-expired leases
+    # whose clients just stop calling would otherwise accumulate forever
+
+    def __init__(self, call, clock=None):
+        import time
+
+        self._call = call
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._last: dict[int, tuple[float, dict]] = {}  # id -> (t, resp)
+        self._forgot: dict[int, float] = {}  # id -> forget() time
+        self.upstream_sent = 0
+        self.coalesced = 0
+
+    def keepalive(self, q: dict) -> dict:
+        lid = int(q.get("ID", 0))
+        now = self._clock()
+        with self._lock:
+            ent = self._last.get(lid)
+            if ent is not None:
+                t, resp = ent
+                ttl = int(resp.get("TTL", 0) or 0)
+                if ttl > 0 and (now - t) < ttl / 3.0:
+                    self.coalesced += 1
+                    return resp
+        res = self._call("/v3/lease/keepalive", q)
+        with self._lock:
+            self.upstream_sent += 1
+            # a revoke that raced this upstream call wins: caching the
+            # pre-revoke success would serve "alive" for a dead lease
+            # until the window lapses
+            if self._forgot.pop(lid, -1.0) < now:
+                self._last[lid] = (self._clock(), res)
+                if len(self._last) > self.MAX_ENTRIES:
+                    oldest = min(self._last, key=lambda k: self._last[k][0])
+                    del self._last[oldest]
+        return res
+
+    def forget(self, lease_id: int) -> None:
+        with self._lock:
+            self._last.pop(lease_id, None)
+            self._forgot[lease_id] = self._clock()
+            if len(self._forgot) > self.MAX_ENTRIES:
+                oldest = min(self._forgot, key=self._forgot.get)
+                del self._forgot[oldest]
+
+
 class Proxy:
     def __init__(self, endpoint: str):
         self.endpoint = endpoint.rstrip("/")
         self.cache = RangeCache()
         self.watches = WatchCoalescer(self.call)
+        self.leases = LeaseCoalescer(self.call)
 
     def call(self, path: str, body: dict) -> dict:
         req = urllib.request.Request(
@@ -186,6 +243,12 @@ class Proxy:
                         base64.b64decode(body["range_end"])
                         if body.get("range_end") else None,
                     )
+            return self.call(path, q)
+        if path == "/v3/lease/keepalive":
+            return self.leases.keepalive(q)
+        if path == "/v3/lease/revoke":
+            # a revoked lease must not serve stale cached keepalives
+            self.leases.forget(int(q.get("ID", 0)))
             return self.call(path, q)
         if path == "/v3/watch":
             if "create_request" in q:

@@ -28,6 +28,16 @@ from etcd_tpu.models.changer import Changer, Config as HostConfig, ConfChangeErr
 from etcd_tpu.server.auth import AuthStore
 from etcd_tpu.server.lease import Lessor
 from etcd_tpu.server.mvcc import ErrCompacted, ErrFutureRev, KeyValue
+from etcd_tpu.server.version import (
+    DowngradeInfo,
+    MIN_CLUSTER_VERSION,
+    SERVER_VERSION,
+    VersionMonitor,
+    allowed_downgrade_version,
+    cluster_version_str,
+    detect_downgrade,
+    major_minor,
+)
 from etcd_tpu.server.watch import WatchableStore
 from etcd_tpu.types import ENTRY_CONF_CHANGE, NONE_ID, ROLE_LEADER
 
@@ -54,6 +64,23 @@ class ErrNoSpace(ServerError):
 
 class ErrCorrupt(ServerError):
     pass
+
+
+class ErrInvalidDowngradeTargetVersion(ServerError):
+    """target must be exactly one minor below the cluster version
+    (v3_server.go:936-938)."""
+
+
+class ErrDowngradeInProcess(ServerError):
+    """a downgrade job is already live (v3_server.go:941-944)."""
+
+
+class ErrNoInflightDowngrade(ServerError):
+    """cancel with no live downgrade job (v3_server.go:979-983)."""
+
+
+class ErrClusterVersionUnavailable(ServerError):
+    """cluster version not yet decided (v3_server.go:930-932)."""
 
 
 @dataclasses.dataclass
@@ -109,6 +136,13 @@ class MemberState:
     durable_index: int = 0
     crashed: bool = False  # host process down: skip apply + donor duty
     _persist_sig: Any = None  # last persisted (applied, rev, compact)
+    # this member binary's version (version.Version; overridable per
+    # member for mixed-version fleets) and its APPLIED view of the
+    # negotiated cluster version + downgrade job — replicated state,
+    # set only through consensus (cluster.go SetVersion/SetDowngradeInfo)
+    server_version: str = SERVER_VERSION
+    cluster_version: str | None = None
+    downgrade: DowngradeInfo = dataclasses.field(default_factory=DowngradeInfo)
 
 
 class EtcdCluster:
@@ -140,6 +174,13 @@ class EtcdCluster:
         # every restart incarnation) shares the provider spec + signing key
         self.auth_token = auth_token
         self.auth_jwt_key = auth_jwt_key
+        # armed by embed's ticker (utils/contention.py): late host ticks
+        # are the TPU analog of the reference's late leader heartbeats
+        self.contention = None
+        # per-member binary-version overrides for mixed-version fleets
+        # (the reference's rolling binary swap); applies at construction
+        # AND at restart-from-disk (see _member_from_backend)
+        self.server_versions: dict[int, str] = {}
         self.members = [
             MemberState(WatchableStore(), Lessor(lease_min_ttl),
                         self._new_auth())
@@ -212,14 +253,25 @@ class EtcdCluster:
         member's backend reaches the committed front. A reference follower
         gets this durability from WAL replay of its committed tail
         (storage.go MustSync + bootstrapWithWAL); here the device ring is
-        the log and dies with the process, so the drain runs eagerly."""
+        the log and dies with the process, so the drain runs eagerly.
+
+        The staged batches are then COMMITTED: _persist only flushes at
+        the batch threshold, so a short-lived cluster that drained its
+        applies could still lose the whole applied_meta record to a
+        subsequent crash (found by test_restart_refused_mid_downgrade —
+        the restart recovered via peer snapshot instead of its own disk,
+        masking the mustDetectDowngrade boot check)."""
         for _ in range(max_rounds):
             live = [
                 ms.applied_index for ms in self.members if not ms.crashed
             ]
             if len(set(live)) <= 1:
-                return
+                break
             self.step()
+        for ms in self.members:
+            if not ms.crashed and ms.backend is not None:
+                ms.backend.commit()
+                ms.durable_index = ms.applied_index
 
     def stabilize(self, max_rounds: int = 64) -> None:
         self.cl.step()
@@ -322,6 +374,8 @@ class EtcdCluster:
             lease_snap=ms.lessor.to_snapshot(),
             auth_snap=ms.auth.to_snapshot(),
             alarms=ms.alarms,
+            cluster_version=ms.cluster_version,
+            downgrade=ms.downgrade.to_dict(),
         )
         # sig records success only after the batch is fully staged: a crash
         # at any marker above re-stages the whole batch on the next pump
@@ -363,22 +417,39 @@ class EtcdCluster:
 
         if self.data_dir is None:
             # memory-only member: nothing on disk — come back empty and
-            # catch up from the ring / a peer snapshot through _pump
-            self.members[m] = MemberState(
+            # catch up from the ring / a peer snapshot through _pump. The
+            # restarting binary keeps its override version, and the boot
+            # check runs AFTER catch-up against whatever cluster state the
+            # peer snapshot restored (the bootstrapExistingClusterNoWAL
+            # case of mustDetectDowngrade).
+            husk = MemberState(
                 WatchableStore(),
                 Lessor(self.members[m].lessor.min_ttl), self._new_auth(),
             )
+            if m in self.server_versions:
+                husk.server_version = self.server_versions[m]
+            self.members[m] = husk
             self._pump()
+            ms = self.members[m]
+            try:
+                detect_downgrade(
+                    ms.server_version, ms.cluster_version, ms.downgrade
+                )
+            except Exception:
+                ms.crashed = True  # refuse to serve on an illegal mix
+                raise
             return
 
         be = Backend(self._backend_path(m))
-        ms, _ = self._member_from_backend(be, self.members[m].lessor.min_ttl)
+        ms, _ = self._member_from_backend(
+            be, self.members[m].lessor.min_ttl, m=m
+        )
         self.members[m] = ms
         # catch up from the device ring (or a peer snapshot if compacted)
         self._pump()
 
     def _member_from_backend(
-        self, be, lease_min_ttl: int = 1
+        self, be, lease_min_ttl: int = 1, m: int | None = None
     ) -> tuple[MemberState, dict]:
         """Rebuild one member's applied state bundle from an open backend
         (the shared tail of bootstrapBackend, bootstrap.go:145)."""
@@ -404,6 +475,16 @@ class EtcdCluster:
         ms.persisted_rev = store.current_rev
         ms.persisted_compact = store.compact_rev
         ms.durable_index = meta["consistent_index"]
+        # recover the replicated version records (cluster.go:263-269),
+        # then refuse to serve on an illegal version mix — the
+        # mustDetectDowngrade boot check (downgrade.go:41-75). The
+        # restarting "binary"'s version comes from the per-member
+        # override map (a rolling binary swap in the reference world).
+        if m is not None and m in self.server_versions:
+            ms.server_version = self.server_versions[m]
+        ms.cluster_version = meta.get("cluster_version")
+        ms.downgrade = DowngradeInfo.from_dict(meta.get("downgrade"))
+        detect_downgrade(ms.server_version, ms.cluster_version, ms.downgrade)
         return ms, meta
 
     @classmethod
@@ -462,7 +543,7 @@ class EtcdCluster:
                 metas.append(None)
                 continue
             be = Backend(path)
-            ms, meta = ec._member_from_backend(be)
+            ms, meta = ec._member_from_backend(be, m=m)
             ec.members[m] = ms
             metas.append(meta)
         present = [meta for meta in metas if meta is not None]
@@ -556,6 +637,11 @@ class EtcdCluster:
             "lease": ms.lessor.to_snapshot(),
             "auth": ms.auth.to_snapshot(),
             "alarms": sorted(ms.alarms),
+            # replicated version records: a snapshot-restored member must
+            # not revert to "version unknown" — that would wedge
+            # versions_match_target (and so monitor_downgrade) forever
+            "cluster_version": ms.cluster_version,
+            "downgrade": ms.downgrade.to_dict(),
         }
 
     def restore_member(self, m: int, snap: dict) -> None:
@@ -567,6 +653,8 @@ class EtcdCluster:
         ms.auth.restore(snap["auth"])
         ms.alarms = set(snap["alarms"])
         ms.applied_index = snap["applied_index"]
+        ms.cluster_version = snap.get("cluster_version")
+        ms.downgrade = DowngradeInfo.from_dict(snap.get("downgrade"))
         ms.results.clear()
 
     def _gc_requests(self) -> None:
@@ -650,6 +738,17 @@ class EtcdCluster:
             else:
                 ms.alarms.discard(req["alarm"])
             return sorted(ms.alarms)
+        if kind == "cluster_version_set":
+            # ClusterVersionSetRequest apply (membership SetVersion):
+            # every member adopts the leader-decided version
+            ms.cluster_version = cluster_version_str(req["ver"])
+            return ms.cluster_version
+        if kind == "downgrade_info_set":
+            # DowngradeInfoSetRequest apply (SetDowngradeInfo)
+            ms.downgrade = DowngradeInfo(
+                req.get("ver", ""), bool(req["enabled"])
+            )
+            return ms.downgrade.enabled
         if kind.startswith("auth_"):
             return self._apply_auth(ms, kind, req)
         raise ServerError(f"unknown request kind {kind}")
@@ -1108,6 +1207,125 @@ class EtcdCluster:
         return self.members[lead].auth.authenticate(name, password)
 
     # ----------------------------------------------------------- maintenance
+    # -- cluster version negotiation + downgrade (monitorVersions /
+    # monitorDowngrade, server.go:2160-2280; Downgrade RPC,
+    # v3_server.go:901-990) ------------------------------------------------
+    def set_server_version(self, m: int, version: str) -> None:
+        """Swap member m's binary version (mixed-version fleets / rolling
+        up-/downgrades). Recorded in the override map so a later
+        restart-from-disk boots the same \"binary\"."""
+        self.server_versions[m] = version
+        self.members[m].server_version = version
+
+    def member_versions(self) -> dict[int, dict | None]:
+        """Per-member {server, cluster} versions; None for unreachable
+        (crashed) members — the cluster_util.go getVersions analog, read
+        in-process instead of over peer HTTP."""
+        return {
+            m: (
+                None
+                if ms.crashed
+                else {
+                    "server": ms.server_version,
+                    "cluster": ms.cluster_version or MIN_CLUSTER_VERSION,
+                }
+            )
+            for m, ms in enumerate(self.members)
+        }
+
+    def cluster_version(self, member: int | None = None) -> str | None:
+        """A member's applied view of the negotiated cluster version
+        (EtcdServer.ClusterVersion)."""
+        if member is None:
+            member = self.leader()
+            if member == NONE_ID or member < 0:
+                member = 0
+        return self.members[member].cluster_version
+
+    def _version_monitor(self, lead: int) -> VersionMonitor:
+        ec = self
+
+        class _Adapter:
+            def get_cluster_version(self):
+                return ec.members[lead].cluster_version
+
+            def get_downgrade_info(self):
+                return ec.members[lead].downgrade
+
+            def get_versions(self):
+                return ec.member_versions()
+
+            def update_cluster_version(self, ver: str):
+                ec._propose(
+                    {"kind": "cluster_version_set", "ver": ver}, member=lead
+                )
+
+            def downgrade_cancel(self):
+                ec._propose(
+                    {"kind": "downgrade_info_set", "enabled": False},
+                    member=lead,
+                )
+
+        return VersionMonitor(_Adapter())
+
+    def monitor_versions(self) -> str | None:
+        """One leader monitor pass: decide min member version, propose a
+        cluster-version bump through consensus when the change is valid.
+        Returns the proposed version, or None. The embed tick loop calls
+        this on the monitorVersionInterval; tests call it directly."""
+        lead = self.leader()
+        if lead == NONE_ID or lead < 0 or self.members[lead].crashed:
+            return None
+        return self._version_monitor(lead).update_cluster_version_if_needed()
+
+    def monitor_downgrade(self) -> bool:
+        """Cancel the live downgrade job once every member's cluster
+        version reached the target (monitorDowngrade)."""
+        lead = self.leader()
+        if lead == NONE_ID or lead < 0 or self.members[lead].crashed:
+            return False
+        return self._version_monitor(lead).cancel_downgrade_if_needed()
+
+    def downgrade(self, action: str, version: str | None = None,
+                  member: int | None = None) -> dict:
+        """Downgrade VALIDATE/ENABLE/CANCEL (v3_server.go:901-990)."""
+        at = member if member is not None else self.ensure_leader()
+        if action == "validate":
+            self.linearizable_read_notify(at)
+            cv = self.members[at].cluster_version
+            if cv is None:
+                raise ErrClusterVersionUnavailable()
+            try:
+                want = major_minor(version or "")
+            except ValueError:
+                raise ErrInvalidDowngradeTargetVersion()
+            if want != major_minor(allowed_downgrade_version(cv)):
+                raise ErrInvalidDowngradeTargetVersion()
+            if self.members[at].downgrade.enabled:
+                raise ErrDowngradeInProcess()
+            return {"version": cv}
+        if action == "enable":
+            res = self.downgrade("validate", version, member=at)
+            target = cluster_version_str(version or "")
+            self._propose(
+                {"kind": "downgrade_info_set", "enabled": True,
+                 "ver": target},
+                member=at,
+            )
+            # the version monitor will now lower the cluster version to
+            # the target (is_valid_version_change accepts the one-minor
+            # downgrade) as its next pass
+            return {"version": res["version"]}
+        if action == "cancel":
+            self.linearizable_read_notify(at)
+            if not self.members[at].downgrade.enabled:
+                raise ErrNoInflightDowngrade()
+            self._propose(
+                {"kind": "downgrade_info_set", "enabled": False}, member=at
+            )
+            return {"version": self.members[at].cluster_version}
+        raise ServerError(f"unknown downgrade action {action}")
+
     def status(self, member: int) -> dict:
         s = self.cl.s
         ms = self.members[member]
@@ -1119,6 +1337,9 @@ class EtcdCluster:
             "db_size": ms.store.kv.size,
             "is_learner": bool(np.asarray(s.learners[member, member, ..., self.c])),
             "alarms": sorted(ms.alarms),
+            "version": ms.server_version,
+            "cluster_version": ms.cluster_version,
+            "downgrade": ms.downgrade.to_dict(),
         }
 
     def hash_kv(self, member: int, rev: int = 0) -> int:

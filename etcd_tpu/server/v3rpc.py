@@ -30,7 +30,9 @@ from etcd_tpu.client import Client, prefix_range_end
 from etcd_tpu.concurrency import Election, Mutex, Session
 from etcd_tpu.server.kvserver import Compare, EtcdCluster, Op, ServerError
 
-__version__ = "3.5.0-tpu.2"
+from etcd_tpu.server.version import MIN_CLUSTER_VERSION, SERVER_VERSION
+
+__version__ = SERVER_VERSION
 
 
 def _b64(b: bytes | None) -> str | None:
@@ -362,6 +364,16 @@ class V3Api:
                 ms.backend.defrag()
         return {"header": {}}
 
+    def maintenance_downgrade(self, q: dict) -> dict:
+        """DowngradeRequest VALIDATE/ENABLE/CANCEL
+        (rpc.proto Maintenance.Downgrade; v3_server.go:901)."""
+        a = q.get("action", 0)
+        if isinstance(a, str):
+            a = {"VALIDATE": 0, "ENABLE": 1, "CANCEL": 2}.get(a.upper(), a)
+        action = {0: "validate", 1: "enable", 2: "cancel"}[int(a)]
+        res = self.ec.downgrade(action, q.get("version"))
+        return {"header": {}, "version": res["version"]}
+
     # -- auth ----------------------------------------------------------------
     # gateway path suffix -> replicated auth request kind
     AUTH_OPS = {
@@ -489,6 +501,7 @@ ROUTES = {
     "/v3/maintenance/alarm": "maintenance_alarm",
     "/v3/maintenance/snapshot": "maintenance_snapshot",
     "/v3/maintenance/defragment": "maintenance_defragment",
+    "/v3/maintenance/downgrade": "maintenance_downgrade",
     "/v3/election/campaign": "election_campaign",
     "/v3/election/proclaim": "election_proclaim",
     "/v3/election/leader": "election_leader",
@@ -531,8 +544,12 @@ class V3Server:
                             self._send(503, {"health": "false",
                                              "reason": str(e)})
                 elif self.path == "/version":
-                    self._send(200, {"etcdserver": __version__,
-                                     "etcdcluster": "3.5.0"})
+                    with api.lock:
+                        cv = api.ec.cluster_version()
+                    self._send(200, {
+                        "etcdserver": __version__,
+                        "etcdcluster": cv or MIN_CLUSTER_VERSION,
+                    })
                 elif self.path == "/metrics":
                     from etcd_tpu.models.metrics import fleet_summary
 
@@ -545,6 +562,16 @@ class V3Server:
                         f"etcd_tpu_commit_apply_lag_max {s['commit_apply_lag_max']}",
                         f"etcd_tpu_term_max {s['term_max']}",
                     ]
+                    td = getattr(api.ec, "contention", None)
+                    if td is not None:
+                        # late-tick contention (pkg/contention analog)
+                        lines.append(
+                            f"etcd_tpu_ticker_late_total {td.late_total}"
+                        )
+                        lines.append(
+                            "etcd_tpu_ticker_late_max_seconds "
+                            f"{td.max_exceeded:.6f}"
+                        )
                     blob = ("\n".join(lines) + "\n").encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain")

@@ -88,9 +88,12 @@ def persist_compaction(be: Backend, store: MVCCStore) -> None:
 def save_applied_meta(
     be: Backend, *, index: int, term: int, store: MVCCStore,
     lease_snap, auth_snap, alarms,
+    cluster_version: str | None = None, downgrade: dict | None = None,
 ) -> None:
     """One record = consistent index + MVCC cursors + the small applied
-    sub-states (lease/auth/alarm buckets of the reference schema)."""
+    sub-states (lease/auth/alarm buckets of the reference schema, plus
+    the cluster-version / downgrade records of membership's backend
+    buckets — cluster.go:263-269 recovers both on boot)."""
     be.put(
         META_BUCKET,
         _APPLIED_META_KEY,
@@ -103,6 +106,8 @@ def save_applied_meta(
                 "lease": lease_snap,
                 "auth": auth_snap,
                 "alarms": sorted(alarms),
+                "cluster_version": cluster_version,
+                "downgrade": downgrade,
             },
             protocol=4,
         ),

@@ -161,6 +161,18 @@ class Msg(struct.PyTreeNode):
 # entry-shaped field can't silently mis-reshape in one of them.
 ENT_FIELDS = ("ent_term", "ent_data", "ent_type")
 
+# int16-wire exemption registry (RaftConfig.wire_int16): (field, msg type)
+# pairs whose values may legally exceed int16 range because the RECEIVER
+# reconstructs them from a registered split — everything else must fit the
+# wire or it corrupts silently (the 81d0b1e MsgSnap hash-truncation bug
+# class). engine.wire_overflow_count enforces this mechanically; register
+# a split here (with the reconstruction masks at both ends) before letting
+# any new wide field ride the wire.
+#   MSG_SNAP.commit: full 32-bit applied hash; low 16 bits survive the
+#   truncate/sign-extend round trip and the high half rides reject_hint
+#   (models/raft.py MsgSnap emit + install).
+WIRE_SPLIT = {("commit", MSG_SNAP)}
+
 
 # [epoch, strong ref to the client the epoch was minted for] — see empty_msg
 _backend_epoch: list = [0, None]

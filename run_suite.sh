@@ -1,0 +1,14 @@
+#!/bin/bash
+# Full-suite run with wall-clock + RSS telemetry (single-core VM: run alone).
+cd /root/repo
+T0=$(date +%s)
+python -m pytest tests/ -q > suite_run.log 2>&1 &
+PYT=$!
+( while kill -0 $PYT 2>/dev/null; do
+    ps -o rss= -p $PYT
+    sleep 15
+  done ) > suite_rss.log 2>/dev/null &
+wait $PYT
+RC=$?
+echo "WALL_SECONDS=$(( $(date +%s) - T0 )) RC=$RC" >> suite_run.log
+exit $RC

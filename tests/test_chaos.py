@@ -54,6 +54,35 @@ def test_chaos_heavy_partitions_stay_safe():
     assert rep["groups_with_leader_after_heal"] == rep["groups"]
 
 
+def test_lease_chaos_expiry_under_faults():
+    """Host-layer lease tier (tester/stresser_lease.go +
+    checker_lease_expire.go analogs): kept-alive leases survive a faulted
+    epoch, abandoned and short-TTL leases expire WITH their keys revoked
+    through consensus."""
+    from etcd_tpu.harness.chaos_lease import run_lease_chaos
+
+    rep = run_lease_chaos(
+        n_members=3, n_leases=4, ttl=4, short_ttl=1,
+        fault_rounds=12, drop_p=0.2, seed=5,
+    )
+    assert rep["lease_violations"] == [], rep
+    assert rep["lease_keepalives_ok"] > 0
+    # the checker must have had at least one determinate kept lease,
+    # or the run proved nothing
+    assert rep["lease_kept_indeterminate"] < rep["lease_kept"], rep
+
+
+def test_runner_chaos_election_exclusion():
+    """Election runners under faults (tester/stresser_runner.go analog):
+    mutual exclusion holds, elections make progress after heal."""
+    from etcd_tpu.harness.chaos_lease import run_runner_chaos
+
+    rep = run_runner_chaos(n_members=3, n_runners=2, fault_rounds=8,
+                           drop_p=0.15, seed=2)
+    assert rep["runner_exclusion_violations"] == 0
+    assert rep["runner_final_progress"]
+
+
 @pytest.mark.skipif(
     not os.environ.get("SCALE_TESTS"),
     reason="BASELINE #3 scale run: set SCALE_TESTS=1 (minutes; meant for TPU)",

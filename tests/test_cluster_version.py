@@ -1,0 +1,167 @@
+"""Cluster version negotiation + downgrade machinery
+(server/etcdserver/version/monitor.go, api/membership/downgrade.go,
+v3_server.go:901-990 — see etcd_tpu/server/version.py)."""
+import pytest
+
+from etcd_tpu.server.kvserver import (
+    ErrDowngradeInProcess,
+    ErrInvalidDowngradeTargetVersion,
+    ErrNoInflightDowngrade,
+    EtcdCluster,
+)
+from etcd_tpu.server.version import (
+    DowngradeInfo,
+    InvalidDowngrade,
+    SERVER_VERSION,
+    allowed_downgrade_version,
+    cluster_version_str,
+    detect_downgrade,
+    is_valid_version_change,
+    parse,
+)
+
+
+# -- pure logic (no fleet) ---------------------------------------------------
+def test_semver_logic():
+    assert parse("3.6.0") == (3, 6, 0)
+    assert parse("3.6.1-tpu.4") == (3, 6, 1)
+    with pytest.raises(ValueError):
+        parse("abc")
+    assert allowed_downgrade_version("3.6.5") == "3.5.0"
+    assert cluster_version_str(SERVER_VERSION) == "3.6.0"
+
+
+def test_is_valid_version_change():
+    # upgrade toward min member version (cluster start)
+    assert is_valid_version_change("3.0.0", "3.6.0")
+    # one-minor downgrade is the ONLY legal decrease
+    assert is_valid_version_change("3.6.0", "3.5.0")
+    assert not is_valid_version_change("3.6.0", "3.4.0")
+    # cross-major moves are rejected either way
+    assert not is_valid_version_change("3.6.0", "4.0.0")
+    assert not is_valid_version_change("4.0.0", "3.6.0")
+    assert not is_valid_version_change("3.6.0", "3.6.0")
+
+
+def test_detect_downgrade_boot_check():
+    # no downgrade job: older server than cluster version refuses to boot
+    with pytest.raises(InvalidDowngrade):
+        detect_downgrade("3.5.0", "3.6.0", None)
+    detect_downgrade("3.6.0", "3.6.0", None)
+    detect_downgrade("3.7.0", "3.6.0", None)
+    # live downgrade job: ONLY target-version servers may join
+    d = DowngradeInfo("3.5.0", True)
+    detect_downgrade("3.5.9", "3.6.0", d)
+    with pytest.raises(InvalidDowngrade):
+        detect_downgrade("3.6.0", "3.6.0", d)
+
+
+def _settle(ec, rounds: int = 6):
+    """Drain apply on ALL members: _propose returns once the serving
+    member applied; followers catch up on subsequent pumps."""
+    for _ in range(rounds):
+        ec.step()
+
+
+# -- negotiation over a live fleet ------------------------------------------
+def test_mixed_version_fleet_negotiates_min():
+    ec = EtcdCluster(n_members=3)
+    ec.ensure_leader()
+    ec.set_server_version(1, "3.5.7")
+    proposed = ec.monitor_versions()
+    # cluster version was unset: first pass decides min(3.6, 3.5, 3.6)
+    assert proposed == "3.5.0"
+    _settle(ec)
+    assert all(ms.cluster_version == "3.5.0" for ms in ec.members)
+    # the laggard upgrades -> next pass raises the cluster version
+    ec.set_server_version(1, SERVER_VERSION)
+    assert ec.monitor_versions() == "3.6.0"
+    assert ec.cluster_version() == "3.6.0"
+    # steady state: nothing to change
+    assert ec.monitor_versions() is None
+
+
+def test_monitor_abstains_while_member_unreachable():
+    ec = EtcdCluster(n_members=3)
+    ec.ensure_leader()
+    assert ec.monitor_versions() == "3.6.0"
+    lead = ec.leader()
+    victim = (lead + 1) % 3
+    ec.members[victim].crashed = True
+    # decideClusterVersion returns nil when any member's version is
+    # unknown -> no change proposed (monitor.go:91-99)
+    assert ec.monitor_versions() is None
+    ec.members[victim].crashed = False
+    assert ec.monitor_versions() is None  # still 3.6.0, nothing to do
+
+
+def test_downgrade_validate_enable_cancel():
+    ec = EtcdCluster(n_members=3)
+    ec.ensure_leader()
+    ec.monitor_versions()
+    assert ec.downgrade("validate", "3.5.0")["version"] == "3.6.0"
+    with pytest.raises(ErrInvalidDowngradeTargetVersion):
+        ec.downgrade("validate", "3.4.0")
+    with pytest.raises(ErrNoInflightDowngrade):
+        ec.downgrade("cancel")
+    ec.downgrade("enable", "3.5.0")
+    _settle(ec)
+    assert all(ms.downgrade.enabled for ms in ec.members)
+    with pytest.raises(ErrDowngradeInProcess):
+        ec.downgrade("validate", "3.5.0")
+    ec.downgrade("cancel")
+    _settle(ec)
+    assert not any(ms.downgrade.enabled for ms in ec.members)
+
+
+def test_full_downgrade_job_completes_and_cancels():
+    """enable -> swap every member's binary to the target -> the monitor
+    lowers the cluster version -> monitorDowngrade cancels the job."""
+    ec = EtcdCluster(n_members=3)
+    ec.ensure_leader()
+    ec.monitor_versions()
+    assert ec.cluster_version() == "3.6.0"
+    ec.downgrade("enable", "3.5.0")
+    # binaries swap one by one; min server version becomes 3.5
+    for m in range(3):
+        ec.set_server_version(m, "3.5.2")
+    assert ec.monitor_versions() == "3.5.0"  # one-minor drop is legal
+    _settle(ec)
+    assert all(ms.cluster_version == "3.5.0" for ms in ec.members)
+    assert ec.monitor_downgrade() is True    # every view matches target
+    _settle(ec)
+    assert not any(ms.downgrade.enabled for ms in ec.members)
+    assert ec.monitor_downgrade() is False
+
+
+def test_version_records_survive_restart(tmp_path):
+    ec = EtcdCluster(n_members=3, data_dir=str(tmp_path))
+    ec.ensure_leader()
+    ec.monitor_versions()
+    _settle(ec)
+    assert ec.cluster_version() == "3.6.0"
+    ec.put(b"k", b"v")
+    ec.sync_for_shutdown()
+    victim = (ec.leader() + 1) % 3
+    ec.crash_member(victim)
+    ec.restart_member_from_disk(victim)
+    assert ec.members[victim].cluster_version == "3.6.0"
+
+
+def test_restart_refused_mid_downgrade(tmp_path):
+    """mustDetectDowngrade: with a downgrade job live, a member restarting
+    on the OLD binary refuses to serve (downgrade.go:58-64)."""
+    ec = EtcdCluster(n_members=3, data_dir=str(tmp_path))
+    ec.ensure_leader()
+    ec.monitor_versions()
+    ec.downgrade("enable", "3.5.0")
+    _settle(ec)
+    ec.sync_for_shutdown()
+    victim = (ec.leader() + 1) % 3
+    ec.crash_member(victim)
+    with pytest.raises(InvalidDowngrade):
+        ec.restart_member_from_disk(victim)
+    # the swapped binary (target version) is allowed in
+    ec.set_server_version(victim, "3.5.2")
+    ec.restart_member_from_disk(victim)
+    assert ec.members[victim].downgrade.enabled

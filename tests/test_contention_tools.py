@@ -1,0 +1,65 @@
+"""Contention detector (pkg/contention analog), proxy lease fan-in
+(grpcproxy/lease.go), and the etcd-dump-metrics tool analog."""
+from etcd_tpu.proxy import LeaseCoalescer
+from etcd_tpu.utils.contention import TimeoutDetector
+
+
+def test_timeout_detector_reports_late_observations():
+    t = [0.0]
+    td = TimeoutDetector(max_duration=1.0, clock=lambda: t[0])
+    assert td.observe("tick") == (True, 0.0)   # first: no baseline
+    t[0] = 0.9
+    assert td.observe("tick") == (True, 0.0)   # on time
+    t[0] = 3.0
+    ok, exceeded = td.observe("tick")          # 2.1s gap, 1.1s late
+    assert not ok and abs(exceeded - 1.1) < 1e-9
+    assert td.late_total == 1 and abs(td.max_exceeded - 1.1) < 1e-9
+    td.reset()
+    t[0] = 10.0
+    assert td.observe("tick") == (True, 0.0)   # history forgotten
+    # independent keys don't blame each other (per-follower records,
+    # raft.go:357 observes per ms[i].To)
+    t[0] = 10.5
+    assert td.observe("other") == (True, 0.0)
+
+
+def test_lease_coalescer_one_upstream_per_interval():
+    calls = []
+    t = [0.0]
+
+    def fake_call(path, q):
+        calls.append((path, int(q["ID"])))
+        return {"ID": q["ID"], "TTL": 30}
+
+    lc = LeaseCoalescer(fake_call, clock=lambda: t[0])
+    # 5 clients keep the same lease alive inside TTL/3 = 10s: ONE upstream
+    for _ in range(5):
+        r = lc.keepalive({"ID": 7})
+        assert r["TTL"] == 30
+    assert lc.upstream_sent == 1 and lc.coalesced == 4
+    assert calls == [("/v3/lease/keepalive", 7)]
+    # a different lease is its own stream
+    lc.keepalive({"ID": 8})
+    assert lc.upstream_sent == 2
+    # past the refresh interval the upstream is refreshed again
+    t[0] = 10.5
+    lc.keepalive({"ID": 7})
+    assert lc.upstream_sent == 3
+    # revoke forgets the cache: next keepalive must hit upstream even
+    # inside the window (no stale TTL for a dead lease)
+    lc.forget(7)
+    lc.keepalive({"ID": 7})
+    assert lc.upstream_sent == 4
+
+
+def test_dump_metrics_enumerates_registry():
+    from etcd_tpu.dump import dump_metrics
+    from etcd_tpu.server.kvserver import EtcdCluster
+
+    ec = EtcdCluster(n_members=1)
+    lines = dump_metrics(ec)
+    names = {ln.split()[0] for ln in lines}
+    assert "etcd_tpu_groups" in names
+    assert "etcd_tpu_ticker_late_total" in names
+    assert "etcd_tpu_ticker_late_max_seconds" in names
+    assert all(len(ln.split()) == 2 for ln in lines)

@@ -69,6 +69,24 @@ def test_proxy_watch_coalescing(stack):
     assert len(proxy.proxy.watches._bcasts) == 0  # upstream dropped
 
 
+def test_proxy_lease_keepalive_fanin(stack):
+    """N clients refreshing one lease through the proxy ride ONE upstream
+    keepalive inside the TTL/3 refresh window (grpcproxy/lease.go:34)."""
+    etcd, proxy = stack
+    p = proxy.port
+    call(p, "/v3/lease/grant", {"ID": "9001", "TTL": "60"})
+    lc = proxy.proxy.leases
+    base_up = lc.upstream_sent
+    for _ in range(4):  # 4 rapid keepalives, window = 20s
+        r = call(p, "/v3/lease/keepalive", {"ID": "9001"})
+        assert int(r["TTL"]) > 0
+    assert lc.upstream_sent == base_up + 1
+    assert lc.coalesced >= 3
+    # revoke drops the cached stream: nothing stale survives
+    call(p, "/v3/lease/revoke", {"ID": "9001"})
+    assert 9001 not in lc._last
+
+
 def test_proxy_health_get_passthrough(stack):
     _, proxy = stack
     with urllib.request.urlopen(

@@ -1,0 +1,109 @@
+"""Mechanical int16-wire safety (engine.wire_overflow_count + types.WIRE_SPLIT).
+
+The 81d0b1e bug class: MsgSnap carried the 32-bit applied hash in `commit`,
+and RaftConfig.wire_int16 silently truncated it — every restored follower
+diverged until the chaos KV_HASH checker caught it. The guard here audits
+the PRE-cast int32 wire every round of a scenario that produces every
+message class (election, replication, read index, conf change, snapshot
+catch-up): any value that would not survive the int16 cast and is not a
+registered split fails loudly. A new wide field on the wire breaks this
+test, not a fleet."""
+import numpy as np
+import jax.numpy as jnp
+
+from etcd_tpu.harness.cluster import Cluster
+from etcd_tpu.models.engine import wire_overflow_count
+from etcd_tpu.types import MSG_APP, MSG_SNAP, Spec
+from etcd_tpu.utils.config import RaftConfig
+
+
+def _audit(cl: Cluster) -> int:
+    return int(wire_overflow_count(cl.spec, cl.eng.inbox))
+
+
+def test_all_message_classes_fit_the_wire_or_are_split():
+    # pre-vote + check-quorum: the healed laggard probes with a prevote
+    # instead of disrupting the stable leader mid-scenario
+    cl = Cluster(3, cfg=RaftConfig(pre_vote=True, check_quorum=True))
+    saw_snap = False
+    snap_commit_overflowed = False
+
+    def step_audit(tick=False):
+        nonlocal saw_snap, snap_commit_overflowed
+        cl.step(tick=tick)
+        assert _audit(cl) == 0, "non-split wire value exceeds int16"
+        typ = np.asarray(cl.eng.inbox.type)
+        com = np.asarray(cl.eng.inbox.commit)
+        snaps = typ == MSG_SNAP
+        if snaps.any():
+            saw_snap = True
+            if (np.abs(com[snaps]) > 2 ** 15 - 1).any():
+                snap_commit_overflowed = True
+
+    # election (vote/vote-resp)
+    cl.campaign(0)
+    for _ in range(6):
+        step_audit()
+    assert cl.leader() == 0
+
+    # replication + heartbeats + read index + conf change
+    cl.propose(0, 7)
+    cl.read_index(0)
+    step_audit(tick=True)
+    for _ in range(4):
+        step_audit()
+
+    # snapshot catch-up: isolate a follower, push past the ring window so
+    # the leader compacts, then heal — replication falls back to MsgSnap
+    # whose `commit` carries the full 32-bit applied hash (the registered
+    # split). The hash is a 32-bit mix, so it exercises the exemption.
+    cl.isolate(2)
+    for r in range(cl.spec.L // cl.spec.E + 4):
+        for e in range(cl.spec.E):
+            cl.propose(0, 1000 + r * cl.spec.E + e)
+        step_audit()
+    assert cl.get("snap_index", 0) > 0, "leader ring never compacted"
+    cl.recover()
+    for _ in range(12):
+        step_audit(tick=True)
+        if saw_snap:
+            break
+    assert saw_snap, "heal never produced a MsgSnap"
+    for _ in range(8):
+        step_audit()
+    assert cl.get("commit", 2) == cl.get("commit", 0), "laggard not caught up"
+    assert cl.get("applied_hash", 2) == cl.get("applied_hash", 0)
+    # the exemption was actually exercised (a truncating value rode commit)
+    assert snap_commit_overflowed, (
+        "applied hash never exceeded int16 — scenario too small to prove "
+        "the split registry matters"
+    )
+
+
+def test_checker_flags_unregistered_wide_field():
+    cl = Cluster(3, cfg=RaftConfig(pre_vote=True, check_quorum=True))
+    cl.campaign(0)
+    cl.stabilize()
+    # a 32-bit value in MsgApp.index is NOT a registered split: flag it
+    cl.inject(to=1, frm=0, type=MSG_APP, index=1 << 20)
+    assert _audit(cl) >= 1
+    # the same value on a MSG_SNAP commit IS registered: clean
+    cl2 = Cluster(3, cfg=RaftConfig(pre_vote=True, check_quorum=True))
+    cl2.campaign(0)
+    cl2.stabilize()
+    cl2.inject(to=1, frm=0, type=MSG_SNAP, commit=-(1 << 20))
+    assert _audit(cl2) == 0
+
+
+def test_checker_rejects_int16_inbox():
+    spec = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                     inbox_bound=4, coalesce_commit_refresh=True,
+                     wire_int16=True)
+    cl = Cluster(n_members=5, C=4, spec=spec, cfg=cfg)
+    assert cl.eng.inbox.term.dtype == jnp.int16
+    try:
+        wire_overflow_count(spec, cl.eng.inbox)
+    except ValueError:
+        return
+    raise AssertionError("int16 inbox must be rejected (audit is pre-cast)")

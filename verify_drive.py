@@ -90,6 +90,29 @@ for _ in range(8):
 ec.corruption_check()
 print("fault + corruption check OK")
 
+# cluster version negotiation + downgrade (round 4): mixed-version fleet
+# settles on the min; a downgrade job runs enable -> binary swap ->
+# version drop -> auto-cancel
+def settle(n=6):
+    for _ in range(n):
+        ec.cl.step(); ec._pump()
+ec.set_server_version(1, "3.5.7")
+assert ec.monitor_versions() == "3.5.0"
+settle()
+ec.set_server_version(1, "3.6.0")
+assert ec.monitor_versions() == "3.6.0"
+settle()
+ec.downgrade("enable", "3.5.0")
+settle()
+for m in range(3):
+    ec.set_server_version(m, "3.5.2")
+assert ec.monitor_versions() == "3.5.0"
+settle()
+assert ec.monitor_downgrade() is True
+settle()
+assert not any(ms.downgrade.enabled for ms in ec.members)
+print("version negotiation + downgrade job: 3.6.0 -> 3.5.0 -> job cancelled")
+
 # padded-lane stabilize: a 3-lane fleet pads to 16; stabilize must converge
 # (padding lanes untic­ked) and see real-lane traffic only
 from etcd_tpu.harness.cluster import Cluster
