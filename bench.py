@@ -43,9 +43,11 @@ NORTH_STAR_GROUP_ROUNDS_PER_SEC = 1_000_000 * 10_000
 
 
 def main() -> None:
+    import dataclasses as _dc
+
     from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
     from etcd_tpu.parallel.mesh import build_scan_rounds, make_fleet_mesh, shard_fleet
-    from etcd_tpu.types import Spec
+    from etcd_tpu.types import MSG_APP, MSG_APP_RESP, MSG_PROP, Spec
     from etcd_tpu.utils.config import RaftConfig
 
     platform = jax.devices()[0].platform
@@ -133,7 +135,21 @@ def main() -> None:
     # message load. Appends act as leader liveness, as in the reference.
     prop_len = z2.at[0].set(1)
     prop_data = zp.at[0, 0].set(7)
-    run = build_scan_rounds(cfg, spec, mesh, rounds=inner)
+    # trace-time specialization of the timed loop: the steady state has no
+    # ticks, no hups (leaders elected above; no ticks -> no timeout fires)
+    # and no read-index traffic, so those full-step passes are statically
+    # dead — and its WIRE TRAFFIC is exactly {MsgApp, MsgAppResp} plus the
+    # local MsgProp, so the other ~14 handler classes are dropped from the
+    # compiled step too (RaftConfig.local_steps / message_classes;
+    # bit-exact equivalence on live steady traffic proven by
+    # tests/test_local_steps.py). Election/settle and the metered
+    # observability pass keep the full program.
+    steady_cfg = _dc.replace(
+        cfg,
+        local_steps=("prop",),
+        message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP),
+    )
+    run = build_scan_rounds(steady_cfg, spec, mesh, rounds=inner)
     args = (prop_len, prop_data, zp, z2, no_hup, no_tick, keep)
 
     state, inbox = run(state, inbox, *args)  # compile + warm

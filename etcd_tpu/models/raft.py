@@ -795,6 +795,15 @@ def handle_snapshot(cfg, spec, n, ob, m: Msg, enable):
 # ---------------------------------------------------------------------------
 
 
+def _handles(cfg: RaftConfig, *types) -> bool:
+    """Trace-time: does this program handle any of these message types?
+    See RaftConfig.message_classes — None handles everything; a declared
+    tuple drops the other handler blocks from the compiled step."""
+    return cfg.message_classes is None or any(
+        t in cfg.message_classes for t in types
+    )
+
+
 def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
     """stepLeader (raft/raft.go:991-1372), minus MsgBeat/MsgCheckQuorum
     (fired directly from tick here)."""
@@ -803,219 +812,226 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
     fhot = ids == m.frm
 
     # ---- MsgProp (raft.go:1019-1077)
-    is_prop = en & (m.type == MSG_PROP)
-    drop = (
-        ~in_config_self(n)
-        | (n.lead_transferee != NONE_ID)
-        | (m.ent_len == 0)
-    )
-    doprop = is_prop & ~drop
-    # conf-change entry guards; refused ccs are blanked to empty normal
-    already_joint = is_joint(n)
-    pend = n.pending_conf_index > n.applied
-    e_type = m.ent_type
-    e_data = m.ent_data
-    new_pci = n.pending_conf_index
-    for e in range(spec.E):
-        valid = doprop & (e < m.ent_len)
-        is_cc = valid & (e_type[e] == ENTRY_CONF_CHANGE)
-        wants_leave = ccmod.is_leave_joint(e_data[e])
-        refused = pend | (already_joint & ~wants_leave) | (~already_joint & wants_leave)
-        keep = is_cc & ~refused
-        e_type = e_type.at[e].set(jnp.where(is_cc & refused, ENTRY_NORMAL, e_type[e]))
-        e_data = e_data.at[e].set(jnp.where(is_cc & refused, 0, e_data[e]))
-        new_pci = jnp.where(keep, n.last_index + e + 1, new_pci)
-        pend = pend | keep
-    n = n.replace(pending_conf_index=jnp.where(doprop, new_pci, n.pending_conf_index))
-    n, accepted = append_entries_state(cfg, spec, n, m.ent_len, e_data, e_type, doprop)
-    n, ob = bcast_append(cfg, spec, n, ob, doprop & accepted)
+    if _handles(cfg, MSG_PROP):
+        is_prop = en & (m.type == MSG_PROP)
+        drop = (
+            ~in_config_self(n)
+            | (n.lead_transferee != NONE_ID)
+            | (m.ent_len == 0)
+        )
+        doprop = is_prop & ~drop
+        # conf-change entry guards; refused ccs are blanked to empty normal
+        already_joint = is_joint(n)
+        pend = n.pending_conf_index > n.applied
+        e_type = m.ent_type
+        e_data = m.ent_data
+        new_pci = n.pending_conf_index
+        for e in range(spec.E):
+            valid = doprop & (e < m.ent_len)
+            is_cc = valid & (e_type[e] == ENTRY_CONF_CHANGE)
+            wants_leave = ccmod.is_leave_joint(e_data[e])
+            refused = pend | (already_joint & ~wants_leave) | (~already_joint & wants_leave)
+            keep = is_cc & ~refused
+            e_type = e_type.at[e].set(jnp.where(is_cc & refused, ENTRY_NORMAL, e_type[e]))
+            e_data = e_data.at[e].set(jnp.where(is_cc & refused, 0, e_data[e]))
+            new_pci = jnp.where(keep, n.last_index + e + 1, new_pci)
+            pend = pend | keep
+        n = n.replace(pending_conf_index=jnp.where(doprop, new_pci, n.pending_conf_index))
+        n, accepted = append_entries_state(cfg, spec, n, m.ent_len, e_data, e_type, doprop)
+        n, ob = bcast_append(cfg, spec, n, ob, doprop & accepted)
 
     # ---- MsgReadIndex (raft.go:1078-1097)
-    is_ri = en & (m.type == MSG_READ_INDEX)
-    singleton = _is_singleton(spec, n)
-    local = (m.frm == NONE_ID) | (m.frm == n.nid)
-    n = _rs_push(spec, n, m.context, n.commit, is_ri & singleton & local)
-    ob = emit_one(
-        spec,
-        ob,
-        m.frm,
-        make_msg(
-            spec, type=MSG_READ_INDEX_RESP, term=n.term, frm=n.nid,
-            index=n.commit, context=m.context,
-        ),
-        is_ri & singleton & ~local,
-        fields=("index",),
-    )
-    cit = _committed_in_term(spec, n)
-    # defer until first commit at this term (raft.go:1087-1092)
-    defer = is_ri & ~singleton & ~cit
-    can_defer = defer & (n.ro_pend_count < spec.R)
-    pos = jnp.minimum(n.ro_pend_count, spec.R - 1)
-    sel = jnp.arange(spec.R, dtype=jnp.int32) == pos
-    n = n.replace(
-        ro_pend_ctx=jnp.where(sel & can_defer, m.context, n.ro_pend_ctx),
-        ro_pend_from=jnp.where(sel & can_defer, m.frm, n.ro_pend_from),
-        ro_pend_count=n.ro_pend_count + can_defer.astype(jnp.int32),
-    )
-    n, ob = _send_read_index_response(
-        cfg, spec, n, ob, m.context, m.frm, is_ri & ~singleton & cit
-    )
+    if _handles(cfg, MSG_READ_INDEX):
+        is_ri = en & (m.type == MSG_READ_INDEX)
+        singleton = _is_singleton(spec, n)
+        local = (m.frm == NONE_ID) | (m.frm == n.nid)
+        n = _rs_push(spec, n, m.context, n.commit, is_ri & singleton & local)
+        ob = emit_one(
+            spec,
+            ob,
+            m.frm,
+            make_msg(
+                spec, type=MSG_READ_INDEX_RESP, term=n.term, frm=n.nid,
+                index=n.commit, context=m.context,
+            ),
+            is_ri & singleton & ~local,
+            fields=("index",),
+        )
+        cit = _committed_in_term(spec, n)
+        # defer until first commit at this term (raft.go:1087-1092)
+        defer = is_ri & ~singleton & ~cit
+        can_defer = defer & (n.ro_pend_count < spec.R)
+        pos = jnp.minimum(n.ro_pend_count, spec.R - 1)
+        sel = jnp.arange(spec.R, dtype=jnp.int32) == pos
+        n = n.replace(
+            ro_pend_ctx=jnp.where(sel & can_defer, m.context, n.ro_pend_ctx),
+            ro_pend_from=jnp.where(sel & can_defer, m.frm, n.ro_pend_from),
+            ro_pend_count=n.ro_pend_count + can_defer.astype(jnp.int32),
+        )
+        n, ob = _send_read_index_response(
+            cfg, spec, n, ob, m.context, m.frm, is_ri & ~singleton & cit
+        )
 
     # ---- messages requiring a Progress entry for m.frm (raft.go:1099-1104)
     has_pr = onehot_sel(_progress_ids(n), frm_c) & (m.frm >= 0)
 
-    # ---- MsgAppResp (raft.go:1106-1283)
-    is_ar = en & (m.type == MSG_APP_RESP) & has_pr
-    n = n.replace(recent_active=n.recent_active | (fhot & is_ar))
-    match_f = onehot_sel(n.match, frm_c)
-    next_f = onehot_sel(n.next_idx, frm_c)
-    repl_f = onehot_sel(n.pr_state, frm_c) == PR_REPLICATE
+    if _handles(cfg, MSG_APP_RESP):
+        # ---- MsgAppResp (raft.go:1106-1283)
+        is_ar = en & (m.type == MSG_APP_RESP) & has_pr
+        n = n.replace(recent_active=n.recent_active | (fhot & is_ar))
+        match_f = onehot_sel(n.match, frm_c)
+        next_f = onehot_sel(n.next_idx, frm_c)
+        repl_f = onehot_sel(n.pr_state, frm_c) == PR_REPLICATE
 
-    # reject path (raft.go:1109-1236)
-    rej = is_ar & m.reject
-    next_probe = jnp.where(
-        m.log_term > 0,
-        logops.find_conflict_by_term(spec, n, m.reject_hint, m.log_term),
-        m.reject_hint,
-    )
-    dec_repl = rej & repl_f & (m.index > match_f)
-    dec_probe = rej & ~repl_f & (next_f - 1 == m.index)
-    new_next = jnp.where(
-        dec_repl,
-        match_f + 1,
-        jnp.maximum(jnp.minimum(m.index, next_probe + 1), 1),
-    )
-    decremented = dec_repl | dec_probe
-    n = n.replace(
-        next_idx=jnp.where(fhot & decremented, new_next, n.next_idx),
-        probe_sent=jnp.where(fhot & dec_probe, False, n.probe_sent),
-        pr_state=jnp.where(fhot & dec_repl, PR_PROBE, n.pr_state),
-        pending_snapshot=jnp.where(fhot & dec_repl, 0, n.pending_snapshot),
-    )
-    n = infl.reset(n, fhot & dec_repl)
-
-    # accept path (raft.go:1237-1282)
-    acc = is_ar & ~m.reject
-    old_paused_f = onehot_sel(_is_paused(cfg, n), frm_c)
-    updated = acc & (m.index > match_f)
-    n = n.replace(
-        match=jnp.where(fhot & updated, m.index, n.match),
-        next_idx=jnp.where(fhot & acc, jnp.maximum(n.next_idx, m.index + 1), n.next_idx),
-        probe_sent=jnp.where(fhot & updated, False, n.probe_sent),
-    )
-    state_f = onehot_sel(n.pr_state, frm_c)
-    new_match = onehot_sel(n.match, frm_c)
-    to_repl = updated & (
-        (state_f == PR_PROBE)
-        | ((state_f == PR_SNAPSHOT) & (new_match >= onehot_sel(n.pending_snapshot, frm_c)))
-    )
-    n = n.replace(
-        pr_state=jnp.where(fhot & to_repl, PR_REPLICATE, n.pr_state),
-        next_idx=jnp.where(fhot & to_repl, new_match + 1, n.next_idx),
-        pending_snapshot=jnp.where(fhot & to_repl, 0, n.pending_snapshot),
-    )
-    n = infl.reset(n, fhot & to_repl)
-    n = infl.free_le(spec, n, fhot & updated & (state_f == PR_REPLICATE), m.index)
-    n2, committed_adv = maybe_commit_state(cfg, spec, n)
-    committed_adv = committed_adv & updated
-    n = tree_where(committed_adv, n2, n)
-    n, ob = _release_pending_read_index(cfg, spec, n, ob, committed_adv)
-
-    # merged send: commit-advance broadcast (raft.go:1259-1263) OR
-    # refresh/drain to the acking follower (1264-1276) OR the reject-path
-    # re-probe (1230-1236); one maybe_send_append inlining covers all three.
-    if cfg.coalesce_commit_refresh:
-        # commit-advance broadcast deferred to node_round's end-of-round
-        # flush (see RaftConfig.coalesce_commit_refresh)
-        send_dest = fhot & (updated | decremented)
-        send_nonempty = decremented | old_paused_f
-    else:
-        send_dest = jnp.where(
-            committed_adv, _progress_ids(n), fhot & (updated | decremented)
+        # reject path (raft.go:1109-1236)
+        rej = is_ar & m.reject
+        next_probe = jnp.where(
+            m.log_term > 0,
+            logops.find_conflict_by_term(spec, n, m.reject_hint, m.log_term),
+            m.reject_hint,
         )
-        send_nonempty = committed_adv | decremented | old_paused_f
-    n, ob = maybe_send_append(cfg, spec, n, ob, send_dest, send_nonempty)
-
-    # leadership transfer (raft.go:1278-1281)
-    xfer = updated & (m.frm == n.lead_transferee) & (onehot_sel(n.match, frm_c) == n.last_index)
-    ob = emit_one(
-        spec,
-        ob,
-        m.frm,
-        make_msg(spec, type=MSG_TIMEOUT_NOW, term=n.term, frm=n.nid),
-        xfer,
-        fields=(),
-    )
-
-    # ---- MsgHeartbeatResp (raft.go:1284-1309)
-    is_hr = en & (m.type == MSG_HEARTBEAT_RESP) & has_pr
-    n = n.replace(
-        recent_active=n.recent_active | (fhot & is_hr),
-        probe_sent=jnp.where(fhot & is_hr, False, n.probe_sent),
-    )
-    n = infl.free_first_one(
-        spec,
-        n,
-        fhot
-        & is_hr
-        & (onehot_sel(n.pr_state, frm_c) == PR_REPLICATE)
-        & onehot_sel(infl.full(cfg.max_inflight, n), frm_c),
-    )
-    n, ob = maybe_send_append(
-        cfg, spec, n, ob, fhot & is_hr & (onehot_sel(n.match, frm_c) < n.last_index), True
-    )
-    if not cfg.read_only_lease_based:
-        hr_ctx = is_hr & (m.context != 0)
-        n, found, row = _ro_recv_ack(spec, n, m.frm, m.context, hr_ctx)
-        won = (
-            quorum.joint_vote_result(n.voters, n.voters_out, row, row) == VOTE_WON
+        dec_repl = rej & repl_f & (m.index > match_f)
+        dec_probe = rej & ~repl_f & (next_f - 1 == m.index)
+        new_next = jnp.where(
+            dec_repl,
+            match_f + 1,
+            jnp.maximum(jnp.minimum(m.index, next_probe + 1), 1),
         )
-        n, ob = _ro_advance_emit(cfg, spec, n, ob, m.context, found & won)
+        decremented = dec_repl | dec_probe
+        n = n.replace(
+            next_idx=jnp.where(fhot & decremented, new_next, n.next_idx),
+            probe_sent=jnp.where(fhot & dec_probe, False, n.probe_sent),
+            pr_state=jnp.where(fhot & dec_repl, PR_PROBE, n.pr_state),
+            pending_snapshot=jnp.where(fhot & dec_repl, 0, n.pending_snapshot),
+        )
+        n = infl.reset(n, fhot & dec_repl)
 
-    # ---- MsgSnapStatus (raft.go:1310-1331)
-    is_ss = en & (m.type == MSG_SNAP_STATUS) & has_pr & (
-        onehot_sel(n.pr_state, frm_c) == PR_SNAPSHOT
-    )
-    pend_f = jnp.where(m.reject, 0, onehot_sel(n.pending_snapshot, frm_c))
-    probe_next = jnp.maximum(onehot_sel(n.match, frm_c) + 1, pend_f + 1)
-    n = n.replace(
-        pr_state=jnp.where(fhot & is_ss, PR_PROBE, n.pr_state),
-        next_idx=jnp.where(fhot & is_ss, probe_next, n.next_idx),
-        pending_snapshot=jnp.where(fhot & is_ss, 0, n.pending_snapshot),
-        probe_sent=jnp.where(fhot & is_ss, True, n.probe_sent),
-    )
-    n = infl.reset(n, fhot & is_ss)
+        # accept path (raft.go:1237-1282)
+        acc = is_ar & ~m.reject
+        old_paused_f = onehot_sel(_is_paused(cfg, n), frm_c)
+        updated = acc & (m.index > match_f)
+        n = n.replace(
+            match=jnp.where(fhot & updated, m.index, n.match),
+            next_idx=jnp.where(fhot & acc, jnp.maximum(n.next_idx, m.index + 1), n.next_idx),
+            probe_sent=jnp.where(fhot & updated, False, n.probe_sent),
+        )
+        state_f = onehot_sel(n.pr_state, frm_c)
+        new_match = onehot_sel(n.match, frm_c)
+        to_repl = updated & (
+            (state_f == PR_PROBE)
+            | ((state_f == PR_SNAPSHOT) & (new_match >= onehot_sel(n.pending_snapshot, frm_c)))
+        )
+        n = n.replace(
+            pr_state=jnp.where(fhot & to_repl, PR_REPLICATE, n.pr_state),
+            next_idx=jnp.where(fhot & to_repl, new_match + 1, n.next_idx),
+            pending_snapshot=jnp.where(fhot & to_repl, 0, n.pending_snapshot),
+        )
+        n = infl.reset(n, fhot & to_repl)
+        n = infl.free_le(spec, n, fhot & updated & (state_f == PR_REPLICATE), m.index)
+        n2, committed_adv = maybe_commit_state(cfg, spec, n)
+        committed_adv = committed_adv & updated
+        n = tree_where(committed_adv, n2, n)
+        n, ob = _release_pending_read_index(cfg, spec, n, ob, committed_adv)
 
-    # ---- MsgUnreachable (raft.go:1332-1338)
-    is_un = en & (m.type == MSG_UNREACHABLE) & has_pr & (
-        onehot_sel(n.pr_state, frm_c) == PR_REPLICATE
-    )
-    n = n.replace(
-        pr_state=jnp.where(fhot & is_un, PR_PROBE, n.pr_state),
-        next_idx=jnp.where(fhot & is_un, onehot_sel(n.match, frm_c) + 1, n.next_idx),
-        pending_snapshot=jnp.where(fhot & is_un, 0, n.pending_snapshot),
-        probe_sent=jnp.where(fhot & is_un, False, n.probe_sent),
-    )
-    n = infl.reset(n, fhot & is_un)
+        # merged send: commit-advance broadcast (raft.go:1259-1263) OR
+        # refresh/drain to the acking follower (1264-1276) OR the reject-path
+        # re-probe (1230-1236); one maybe_send_append inlining covers all three.
+        if cfg.coalesce_commit_refresh:
+            # commit-advance broadcast deferred to node_round's end-of-round
+            # flush (see RaftConfig.coalesce_commit_refresh)
+            send_dest = fhot & (updated | decremented)
+            send_nonempty = decremented | old_paused_f
+        else:
+            send_dest = jnp.where(
+                committed_adv, _progress_ids(n), fhot & (updated | decremented)
+            )
+            send_nonempty = committed_adv | decremented | old_paused_f
+        n, ob = maybe_send_append(cfg, spec, n, ob, send_dest, send_nonempty)
 
-    # ---- MsgTransferLeader (raft.go:1339-1369)
-    is_tl = en & (m.type == MSG_TRANSFER_LEADER) & has_pr
-    ignore = onehot_sel(n.learners, frm_c) | (m.frm == n.nid) | (n.lead_transferee == m.frm)
-    do_tl = is_tl & ~ignore
-    n = n.replace(
-        election_elapsed=jnp.where(do_tl, 0, n.election_elapsed),
-        lead_transferee=jnp.where(do_tl, m.frm, n.lead_transferee),
-    )
-    up_to_date = onehot_sel(n.match, frm_c) == n.last_index
-    ob = emit_one(
-        spec,
-        ob,
-        m.frm,
-        make_msg(spec, type=MSG_TIMEOUT_NOW, term=n.term, frm=n.nid),
-        do_tl & up_to_date,
-        fields=(),
-    )
-    n, ob = maybe_send_append(cfg, spec, n, ob, fhot & do_tl & ~up_to_date, True)
+        # leadership transfer (raft.go:1278-1281)
+        xfer = updated & (m.frm == n.lead_transferee) & (onehot_sel(n.match, frm_c) == n.last_index)
+        ob = emit_one(
+            spec,
+            ob,
+            m.frm,
+            make_msg(spec, type=MSG_TIMEOUT_NOW, term=n.term, frm=n.nid),
+            xfer,
+            fields=(),
+        )
+
+    if _handles(cfg, MSG_HEARTBEAT_RESP):
+        # ---- MsgHeartbeatResp (raft.go:1284-1309)
+        is_hr = en & (m.type == MSG_HEARTBEAT_RESP) & has_pr
+        n = n.replace(
+            recent_active=n.recent_active | (fhot & is_hr),
+            probe_sent=jnp.where(fhot & is_hr, False, n.probe_sent),
+        )
+        n = infl.free_first_one(
+            spec,
+            n,
+            fhot
+            & is_hr
+            & (onehot_sel(n.pr_state, frm_c) == PR_REPLICATE)
+            & onehot_sel(infl.full(cfg.max_inflight, n), frm_c),
+        )
+        n, ob = maybe_send_append(
+            cfg, spec, n, ob, fhot & is_hr & (onehot_sel(n.match, frm_c) < n.last_index), True
+        )
+        if not cfg.read_only_lease_based:
+            hr_ctx = is_hr & (m.context != 0)
+            n, found, row = _ro_recv_ack(spec, n, m.frm, m.context, hr_ctx)
+            won = (
+                quorum.joint_vote_result(n.voters, n.voters_out, row, row) == VOTE_WON
+            )
+            n, ob = _ro_advance_emit(cfg, spec, n, ob, m.context, found & won)
+
+    if _handles(cfg, MSG_SNAP_STATUS):
+        # ---- MsgSnapStatus (raft.go:1310-1331)
+        is_ss = en & (m.type == MSG_SNAP_STATUS) & has_pr & (
+            onehot_sel(n.pr_state, frm_c) == PR_SNAPSHOT
+        )
+        pend_f = jnp.where(m.reject, 0, onehot_sel(n.pending_snapshot, frm_c))
+        probe_next = jnp.maximum(onehot_sel(n.match, frm_c) + 1, pend_f + 1)
+        n = n.replace(
+            pr_state=jnp.where(fhot & is_ss, PR_PROBE, n.pr_state),
+            next_idx=jnp.where(fhot & is_ss, probe_next, n.next_idx),
+            pending_snapshot=jnp.where(fhot & is_ss, 0, n.pending_snapshot),
+            probe_sent=jnp.where(fhot & is_ss, True, n.probe_sent),
+        )
+        n = infl.reset(n, fhot & is_ss)
+
+    if _handles(cfg, MSG_UNREACHABLE):
+        # ---- MsgUnreachable (raft.go:1332-1338)
+        is_un = en & (m.type == MSG_UNREACHABLE) & has_pr & (
+            onehot_sel(n.pr_state, frm_c) == PR_REPLICATE
+        )
+        n = n.replace(
+            pr_state=jnp.where(fhot & is_un, PR_PROBE, n.pr_state),
+            next_idx=jnp.where(fhot & is_un, onehot_sel(n.match, frm_c) + 1, n.next_idx),
+            pending_snapshot=jnp.where(fhot & is_un, 0, n.pending_snapshot),
+            probe_sent=jnp.where(fhot & is_un, False, n.probe_sent),
+        )
+        n = infl.reset(n, fhot & is_un)
+
+    if _handles(cfg, MSG_TRANSFER_LEADER):
+        # ---- MsgTransferLeader (raft.go:1339-1369)
+        is_tl = en & (m.type == MSG_TRANSFER_LEADER) & has_pr
+        ignore = onehot_sel(n.learners, frm_c) | (m.frm == n.nid) | (n.lead_transferee == m.frm)
+        do_tl = is_tl & ~ignore
+        n = n.replace(
+            election_elapsed=jnp.where(do_tl, 0, n.election_elapsed),
+            lead_transferee=jnp.where(do_tl, m.frm, n.lead_transferee),
+        )
+        up_to_date = onehot_sel(n.match, frm_c) == n.last_index
+        ob = emit_one(
+            spec,
+            ob,
+            m.frm,
+            make_msg(spec, type=MSG_TIMEOUT_NOW, term=n.term, frm=n.nid),
+            do_tl & up_to_date,
+            fields=(),
+        )
+        n, ob = maybe_send_append(cfg, spec, n, ob, fhot & do_tl & ~up_to_date, True)
     return n, ob
 
 
@@ -1024,6 +1040,8 @@ def _step_candidate(cfg, spec, n, ob, m: Msg, en):
     handled by the demote-first rewrite in process_message (the candidate has
     already become a follower by the time dispatch runs), so only the vote
     responses remain here."""
+    if not _handles(cfg, MSG_VOTE_RESP, MSG_PRE_VOTE_RESP):
+        return n, ob  # only vote responses are handled here (see docstring)
     pre = n.role == ROLE_PRE_CANDIDATE
     my_resp = jnp.where(pre, MSG_PRE_VOTE_RESP, MSG_VOTE_RESP)
     is_vr = en & (m.type == my_resp)
@@ -1053,11 +1071,12 @@ def _step_candidate(cfg, spec, n, ob, m: Msg, en):
 def _step_follower(cfg, spec, n, ob, m: Msg, en):
     """stepFollower (raft/raft.go:1421-1473)."""
     # MsgProp: forward to the leader if known (raft.go:1423-1432)
-    is_prop = en & (m.type == MSG_PROP)
-    fwd_ok = (n.lead != NONE_ID) & (not cfg.disable_proposal_forwarding)
-    ob = emit_one(
-        spec, ob, n.lead, m.replace(frm=n.nid, term=jnp.int32(0)), is_prop & fwd_ok
-    )
+    if _handles(cfg, MSG_PROP):
+        is_prop = en & (m.type == MSG_PROP)
+        fwd_ok = (n.lead != NONE_ID) & (not cfg.disable_proposal_forwarding)
+        ob = emit_one(
+            spec, ob, n.lead, m.replace(frm=n.nid, term=jnp.int32(0)), is_prop & fwd_ok
+        )
 
     # MsgApp/MsgHeartbeat/MsgSnap from the leader (raft.go:1433-1444)
     lead_msg = en & (
@@ -1067,25 +1086,31 @@ def _step_follower(cfg, spec, n, ob, m: Msg, en):
         election_elapsed=jnp.where(lead_msg, 0, n.election_elapsed),
         lead=jnp.where(lead_msg, m.frm, n.lead),
     )
-    n, ob = handle_append_entries(cfg, spec, n, ob, m, lead_msg & (m.type == MSG_APP))
-    n, ob = handle_heartbeat(cfg, spec, n, ob, m, lead_msg & (m.type == MSG_HEARTBEAT))
-    n, ob = handle_snapshot(cfg, spec, n, ob, m, lead_msg & (m.type == MSG_SNAP))
+    if _handles(cfg, MSG_APP):
+        n, ob = handle_append_entries(cfg, spec, n, ob, m, lead_msg & (m.type == MSG_APP))
+    if _handles(cfg, MSG_HEARTBEAT):
+        n, ob = handle_heartbeat(cfg, spec, n, ob, m, lead_msg & (m.type == MSG_HEARTBEAT))
+    if _handles(cfg, MSG_SNAP):
+        n, ob = handle_snapshot(cfg, spec, n, ob, m, lead_msg & (m.type == MSG_SNAP))
 
     # MsgTransferLeader / MsgReadIndex forwarded to the leader (1445-1451, 1458-1464)
-    fwd = en & (
-        (m.type == MSG_TRANSFER_LEADER) | (m.type == MSG_READ_INDEX)
-    ) & (n.lead != NONE_ID)
-    ob = emit_one(spec, ob, n.lead, m, fwd)
+    if _handles(cfg, MSG_TRANSFER_LEADER, MSG_READ_INDEX):
+        fwd = en & (
+            (m.type == MSG_TRANSFER_LEADER) | (m.type == MSG_READ_INDEX)
+        ) & (n.lead != NONE_ID)
+        ob = emit_one(spec, ob, n.lead, m, fwd)
 
     # MsgTimeoutNow: campaign immediately, no pre-vote (raft.go:1452-1457)
-    ob = _emit_hup_to_self(
-        spec, n, ob, CAMPAIGN_TRANSFER, en & (m.type == MSG_TIMEOUT_NOW)
-    )
+    if _handles(cfg, MSG_TIMEOUT_NOW):
+        ob = _emit_hup_to_self(
+            spec, n, ob, CAMPAIGN_TRANSFER, en & (m.type == MSG_TIMEOUT_NOW)
+        )
 
     # MsgReadIndexResp -> local ReadState (raft.go:1465-1471)
-    n = _rs_push(
-        spec, n, m.context, m.index, en & (m.type == MSG_READ_INDEX_RESP)
-    )
+    if _handles(cfg, MSG_READ_INDEX_RESP):
+        n = _rs_push(
+            spec, n, m.context, m.index, en & (m.type == MSG_READ_INDEX_RESP)
+        )
     return n, ob
 
 
@@ -1122,60 +1147,64 @@ def process_message(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, m: Ms
     n = tree_where(do_bf, nbf, n)
 
     # lower-term handling consumes the message (raft.go:883-919)
-    lt_push = (
-        lower
-        & (cfg.check_quorum or cfg.pre_vote)
-        & ((m.type == MSG_HEARTBEAT) | (m.type == MSG_APP))
-    )
-    ob = emit_one(
-        spec,
-        ob,
-        m.frm,
-        make_msg(spec, type=MSG_APP_RESP, term=n.term, frm=n.nid),
-        lt_push,
-        fields=(),
-    )
-    lt_prevote = lower & (m.type == MSG_PRE_VOTE)
-    ob = emit_one(
-        spec,
-        ob,
-        m.frm,
-        make_msg(spec, type=MSG_PRE_VOTE_RESP, term=n.term, frm=n.nid, reject=True),
-        lt_prevote,
-        fields=(),
-    )
+    if _handles(cfg, MSG_HEARTBEAT, MSG_APP):
+        lt_push = (
+            lower
+            & (cfg.check_quorum or cfg.pre_vote)
+            & ((m.type == MSG_HEARTBEAT) | (m.type == MSG_APP))
+        )
+        ob = emit_one(
+            spec,
+            ob,
+            m.frm,
+            make_msg(spec, type=MSG_APP_RESP, term=n.term, frm=n.nid),
+            lt_push,
+            fields=(),
+        )
+    if _handles(cfg, MSG_PRE_VOTE):
+        lt_prevote = lower & (m.type == MSG_PRE_VOTE)
+        ob = emit_one(
+            spec,
+            ob,
+            m.frm,
+            make_msg(spec, type=MSG_PRE_VOTE_RESP, term=n.term, frm=n.nid, reject=True),
+            lt_prevote,
+            fields=(),
+        )
     proceed = active & ~drop_lease & ~lower
 
     # ---- MsgHup (raft.go:923-928); the single campaign() inlining
-    n, ob = hup(cfg, spec, n, ob, m.context, proceed & (m.type == MSG_HUP))
+    if _handles(cfg, MSG_HUP):
+        n, ob = hup(cfg, spec, n, ob, m.context, proceed & (m.type == MSG_HUP))
 
     # ---- Msg{Pre,}Vote for any role (raft.go:930-978)
-    is_vreq = proceed & vote_like
-    can_vote = (
-        (n.vote == m.frm)
-        | ((n.vote == NONE_ID) & (n.lead == NONE_ID))
-        | ((m.type == MSG_PRE_VOTE) & (m.term > n.term))
-    )
-    utd = logops.is_up_to_date(spec, n, m.index, m.log_term)
-    grant = is_vreq & can_vote & utd
-    resp_type = jnp.where(m.type == MSG_VOTE, MSG_VOTE_RESP, MSG_PRE_VOTE_RESP)
-    ob = emit_one(
-        spec,
-        ob,
-        m.frm,
-        make_msg(spec, frm=n.nid).replace(
-            type=resp_type,
-            term=jnp.where(grant, m.term, n.term),
-            reject=~grant,
-        ),
-        is_vreq,
-        fields=(),
-    )
-    real_grant = grant & (m.type == MSG_VOTE)
-    n = n.replace(
-        election_elapsed=jnp.where(real_grant, 0, n.election_elapsed),
-        vote=jnp.where(real_grant, m.frm, n.vote),
-    )
+    if _handles(cfg, MSG_VOTE, MSG_PRE_VOTE):
+        is_vreq = proceed & vote_like
+        can_vote = (
+            (n.vote == m.frm)
+            | ((n.vote == NONE_ID) & (n.lead == NONE_ID))
+            | ((m.type == MSG_PRE_VOTE) & (m.term > n.term))
+        )
+        utd = logops.is_up_to_date(spec, n, m.index, m.log_term)
+        grant = is_vreq & can_vote & utd
+        resp_type = jnp.where(m.type == MSG_VOTE, MSG_VOTE_RESP, MSG_PRE_VOTE_RESP)
+        ob = emit_one(
+            spec,
+            ob,
+            m.frm,
+            make_msg(spec, frm=n.nid).replace(
+                type=resp_type,
+                term=jnp.where(grant, m.term, n.term),
+                reject=~grant,
+            ),
+            is_vreq,
+            fields=(),
+        )
+        real_grant = grant & (m.type == MSG_VOTE)
+        n = n.replace(
+            election_elapsed=jnp.where(real_grant, 0, n.election_elapsed),
+            vote=jnp.where(real_grant, m.frm, n.vote),
+        )
 
     # ---- candidates seeing a current leader demote first (raft.go:1390-1398)
     rest = proceed & ~vote_like & (m.type != MSG_HUP)
@@ -1385,9 +1414,23 @@ def node_round(
     """One lockstep round for one node: tick -> [hup, inbox..., prop,
     read-index] message scan -> apply. Returns (state, outbox)."""
     ob = empty_outbox(spec)
-    n, ob, fire = tick_timers(cfg, spec, n, ob, jnp.asarray(do_tick, jnp.bool_))
+    if "tick" in cfg.local_steps:
+        n, ob, fire = tick_timers(
+            cfg, spec, n, ob, jnp.asarray(do_tick, jnp.bool_)
+        )
+    else:
+        # never-ticking program (bench steady loop): tick_timers is a
+        # pure masked no-op when do_tick is all-False — dropped at trace
+        # time (RaftConfig.local_steps)
+        fire = jnp.zeros_like(jnp.asarray(do_tick, jnp.bool_))
     commit0 = n.commit  # round-start commit, for the coalesced flush below
 
+    # Each local step below is one full masked pass over node state; the
+    # cfg.local_steps tuple drops statically-dead ones from perf programs
+    # (see RaftConfig.local_steps for the soundness argument).
+    do_hup_step = "hup" in cfg.local_steps
+    do_prop_step = "prop" in cfg.local_steps
+    do_ri_step = "read_index" in cfg.local_steps
     hup_msg = make_msg(spec, frm=n.nid).replace(
         type=jnp.where(do_hup | fire, MSG_HUP, MSG_NONE),
         context=jnp.int32(CAMPAIGN_NONE),
@@ -1410,7 +1453,8 @@ def node_round(
     # fleet C (XLA placed the tiny E axis minor: 5x65536x2x5x1 ->
     # 2.5GB x3 in the C=65536 compile report); slicing the inbox in
     # place has no such copy.
-    n, ob = process_message(cfg, spec, n, ob, hup_msg)
+    if do_hup_step:
+        n, ob = process_message(cfg, spec, n, ob, hup_msg)
 
     flat = jax.tree.map(
         lambda x: x.reshape((spec.M * spec.K,) + x.shape[2:]), inbox
@@ -1431,8 +1475,10 @@ def node_round(
 
     (n, ob), _ = jax.lax.scan(body, (n, ob), flat)
 
-    n, ob = process_message(cfg, spec, n, ob, prop_msg)
-    n, ob = process_message(cfg, spec, n, ob, ri_msg)
+    if do_prop_step:
+        n, ob = process_message(cfg, spec, n, ob, prop_msg)
+    if do_ri_step:
+        n, ob = process_message(cfg, spec, n, ob, ri_msg)
 
     if cfg.coalesce_commit_refresh:
         # End-of-round commit flush, replacing the per-ack bcastAppend

@@ -28,6 +28,37 @@ class RaftConfig:
     read_only_lease_based: bool = False
     # raft.Config.DisableProposalForwarding
     disable_proposal_forwarding: bool = False
+    # Which synthesized LOCAL message steps node_round traces, in its
+    # fixed order [hup, inbox..., prop, read_index]. Each listed step is
+    # one more full masked pass over fleet state per round — the round
+    # program's unit of cost — and a step whose inputs are all-absent at
+    # runtime is a pure no-op that still pays that pass. Steady-state
+    # perf programs (bench: elected fleets, one proposal per group per
+    # round, no reads) drop "hup"/"read_index" AT TRACE TIME and keep a
+    # second full-step program for the election/read phases; equivalence
+    # of the dropped-step program on absent inputs is proven by
+    # tests/test_local_steps.py. NOTE: timeout-driven campaigns ALSO ride
+    # the hup step (tick_timers' fire flag) — dropping "hup" is only
+    # sound for programs that never tick (the bench steady loop) or
+    # whose elections are driven externally. "tick" gates the
+    # tick_timers pass the same way: with do_tick all-False it is a pure
+    # masked no-op, so programs that never tick drop it at trace time.
+    local_steps: tuple = ("tick", "hup", "prop", "read_index")
+    # Which MESSAGE TYPES this program's step handles (None = all). Each
+    # handler block in process_message/_step_* is one or more full masked
+    # passes over fleet state that XLA must execute even when its type
+    # mask is runtime-false — at 5 serial message slots per round, the
+    # ~14 steady-dead handler classes are most of the round's HBM
+    # traffic. A steady-state program declares its traffic, e.g.
+    # (MSG_APP, MSG_APP_RESP, MSG_PROP), and the other handlers are
+    # DROPPED AT TRACE TIME. Contract: bit-identical to the full program
+    # as long as no message of an omitted type reaches the step
+    # (tests/test_local_steps.py proves it on live steady traffic); a
+    # program that might see elections, snapshots, leadership transfer
+    # or reads must keep the default. Term/lease preamble and candidate
+    # demotion stay unconditionally — they key on message TERMS and
+    # roles, not on declared classes.
+    message_classes: tuple | None = None
     # Compact each node's inbox (nonempty slots to the front, original
     # order preserved) and process only the first `inbox_bound` slots per
     # round instead of all M*K. Messages past the bound are DROPPED —
@@ -80,6 +111,30 @@ class RaftConfig:
             raise ValueError("election tick must be greater than heartbeat tick")
         if self.read_only_lease_based and not self.check_quorum:
             raise ValueError("CheckQuorum must be enabled for lease-based reads")
+        known = {"tick", "hup", "prop", "read_index"}
+        bad = set(self.local_steps) - known
+        if bad:
+            raise ValueError(f"unknown local_steps {sorted(bad)}; known: "
+                             f"{sorted(known)}")
+        if "tick" in self.local_steps and "hup" not in self.local_steps:
+            # tick_timers' election-timeout fire rides the hup step; a
+            # ticking program without it silently discards every campaign
+            raise ValueError('local_steps with "tick" requires "hup" '
+                             "(timeout campaigns ride the hup step)")
+        if self.message_classes is not None:
+            # a kept local injection step whose message class is compiled
+            # out would synthesize messages nobody handles
+            from etcd_tpu import types as _t
+
+            need = {"hup": _t.MSG_HUP, "prop": _t.MSG_PROP,
+                    "read_index": _t.MSG_READ_INDEX}
+            for step, mtype in need.items():
+                if step in self.local_steps and mtype not in self.message_classes:
+                    raise ValueError(
+                        f'local step "{step}" is kept but its message type '
+                        "is not in message_classes — its messages would be "
+                        "silently swallowed"
+                    )
 
     @property
     def max_uncommitted_entries(self) -> int:

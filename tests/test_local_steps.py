@@ -1,0 +1,89 @@
+"""RaftConfig.local_steps: trace-time removal of statically-dead local
+message passes (bench steady program). Equivalence contract: with no hups,
+no ticks and no read-index inputs, the ("prop",)-only program must
+reproduce the full program bit-for-bit — the dropped steps were pure
+masked no-ops, each costing a full pass over fleet state."""
+import dataclasses
+
+import numpy as np
+import jax
+
+from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
+from etcd_tpu.types import ENTRY_NORMAL, ROLE_LEADER, Spec
+from etcd_tpu.utils.config import RaftConfig
+
+SPEC = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+CFG = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                 inbox_bound=4, coalesce_commit_refresh=True)
+C = 4
+
+
+def _elect(full):
+    M, E = SPEC.M, SPEC.E
+    state = init_fleet(SPEC, C, seed=0, election_tick=CFG.election_tick)
+    inbox = empty_inbox(SPEC, C)
+    z2 = np.zeros((M, C), np.int32)
+    zp = np.zeros((M, E, C), np.int32)
+    no = np.zeros((M, C), bool)
+    keep = np.ones((M, M, C), bool)
+    hup = no.copy()
+    hup[0, :] = True
+    state, inbox = full(state, inbox, z2, zp, zp, z2, hup, no, keep)
+    for _ in range(12):
+        state, inbox = full(state, inbox, z2, zp, zp, z2, no, no, keep)
+    assert (np.asarray(state.role)[0] == ROLE_LEADER).all()
+    return state, inbox, (z2, zp, no, keep)
+
+
+def test_prop_only_program_is_bit_identical_in_steady_state():
+    full = jax.jit(build_round(CFG, SPEC))
+    steady = jax.jit(
+        build_round(dataclasses.replace(CFG, local_steps=("prop",)), SPEC)
+    )
+    state0, inbox0, (z2, zp, no, keep) = _elect(full)
+    _assert_equiv(full, steady, state0, inbox0, z2, zp, no, keep)
+
+
+def test_declared_classes_program_is_bit_identical_in_steady_state():
+    """The bench steady program (local_steps=("prop",) AND
+    message_classes={App, AppResp, Prop}) against live steady traffic."""
+    from etcd_tpu.types import MSG_APP, MSG_APP_RESP, MSG_PROP
+
+    full = jax.jit(build_round(CFG, SPEC))
+    steady = jax.jit(
+        build_round(
+            dataclasses.replace(
+                CFG,
+                local_steps=("prop",),
+                message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP),
+            ),
+            SPEC,
+        )
+    )
+    state0, inbox0, (z2, zp, no, keep) = _elect(full)
+    _assert_equiv(full, steady, state0, inbox0, z2, zp, no, keep)
+
+
+def _assert_equiv(full, steady, state0, inbox0, z2, zp, no, keep):
+
+    plen = z2.copy()
+    plen[0, :] = 1
+    pdata = zp.copy()
+    pdata[0, 0, :] = 7
+    ptype = zp.copy()
+    ptype[0, 0, :] = ENTRY_NORMAL
+
+    sa, ia = state0, inbox0
+    sb, ib = state0, inbox0
+    for r in range(10):
+        sa, ia = full(sa, ia, plen, pdata, ptype, z2, no, no, keep)
+        sb, ib = steady(sb, ib, plen, pdata, ptype, z2, no, no, keep)
+    assert int(np.asarray(sa.commit).min()) >= 8  # really replicating
+    for name in sa.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(sa, name)), np.asarray(getattr(sb, name))
+        ), f"state.{name}"
+    for name in ia.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(ia, name)), np.asarray(getattr(ib, name))
+        ), f"inbox.{name}"
