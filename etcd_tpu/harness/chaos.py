@@ -114,6 +114,7 @@ def build_chaos_epoch(
     faultless: bool = False,
     partition_period: int = 25,
     tick: bool = True,
+    with_delay: bool = True,
 ):
     """One jitted chaos epoch: `rounds` lockstep rounds of faulted traffic
     with per-round invariant checks.
@@ -133,6 +134,15 @@ def build_chaos_epoch(
     across sides drop entirely); other faults stack on top. `faultless`
     selects the structurally-reduced heal program (no sampling, no held
     bookkeeping), which ignores the probability operands.
+
+    `with_delay=False` removes the delay/reorder machinery AT TRACE TIME:
+    no Bernoulli delay draws, no held-buffer merge, and — decisively —
+    no held pytree in the scan carry. The held buffer is a full second
+    inbox (17 x [M, K*M, C] leaves) whose while-loop double-buffering
+    alone overflows HBM at the 1M-group configuration (measured:
+    17.01G/15.75G); the 1M chaos tier runs drop+partition mixes without
+    it, while delay/reorder coverage runs at <=524k. Callers pass
+    held=None and get None back.
     """
     round_fn = build_round(cfg, spec)
     M = spec.M
@@ -153,8 +163,9 @@ def build_chaos_epoch(
             # whatever the previous chaos epoch still held by merging it
             # into the entry inbox once (held wins a slot collision, as in
             # _merge_delayed), then run bare rounds with per-round checks.
-            inbox = _held_wins(spec, held, inbox)
-            held = jax.tree.map(jnp.zeros_like, held)
+            if with_delay:
+                inbox = _held_wins(spec, held, inbox)
+                held = jax.tree.map(jnp.zeros_like, held)
             keep_all = jnp.ones((M, M, C), jnp.bool_)
 
             def heal_body(carry, r):
@@ -173,8 +184,7 @@ def build_chaos_epoch(
             return (state, inbox, held, key, viol,
                     state.commit.sum() - commit0)
 
-        def body(carry, r):
-            state, inbox, held, key, viol, prev_commit = carry
+        def sample_keep(key, r):
             key, kd, kl = jax.random.split(key, 3)
             # rolling partition: drawn from the epoch-stable pkey folded
             # with the period index, so the cut holds for a whole period
@@ -188,22 +198,42 @@ def build_chaos_epoch(
             same_side = side[:, None, :] == side[None, :, :]  # [M, M, C]
             keep_part = same_side | ~partitioned[None, None, :]
             keep_drop = jax.random.bernoulli(kd, 1.0 - drop_p, (M, M, C))
-            keep = keep_part & keep_drop
+            return key, kl, keep_part & keep_drop
 
-            state, out = round_fn(
-                state, inbox, prop_len, prop_data, zp, z2, no, do_tick, keep
+        if with_delay:
+            def body(carry, r):
+                state, inbox, held, key, viol, prev_commit = carry
+                key, kl, keep = sample_keep(key, r)
+                state, out = round_fn(
+                    state, inbox, prop_len, prop_data, zp, z2, no,
+                    do_tick, keep
+                )
+                delay = jax.random.bernoulli(
+                    kl, delay_p, (M, spec.K * M, C)
+                ) & (out.type != 0)
+                nxt, held2 = _merge_delayed(spec, out, held, delay)
+                viol = check_invariants(state, prev_commit, viol)
+                return (state, nxt, held2, key, viol, state.commit), None
+
+            (state, inbox, held, key, viol, prev_commit), _ = jax.lax.scan(
+                body, (state, inbox, held, key, viol, prev_commit),
+                jnp.arange(rounds, dtype=jnp.int32),
             )
-            delay = jax.random.bernoulli(
-                kl, delay_p, (M, spec.K * M, C)
-            ) & (out.type != 0)
-            nxt, held2 = _merge_delayed(spec, out, held, delay)
-            viol = check_invariants(state, prev_commit, viol)
-            return (state, nxt, held2, key, viol, state.commit), None
+        else:
+            def body(carry, r):
+                state, inbox, key, viol, prev_commit = carry
+                key, _, keep = sample_keep(key, r)
+                state, out = round_fn(
+                    state, inbox, prop_len, prop_data, zp, z2, no,
+                    do_tick, keep
+                )
+                viol = check_invariants(state, prev_commit, viol)
+                return (state, out, key, viol, state.commit), None
 
-        (state, inbox, held, key, viol, prev_commit), _ = jax.lax.scan(
-            body, (state, inbox, held, key, viol, prev_commit),
-            jnp.arange(rounds, dtype=jnp.int32),
-        )
+            (state, inbox, key, viol, prev_commit), _ = jax.lax.scan(
+                body, (state, inbox, key, viol, prev_commit),
+                jnp.arange(rounds, dtype=jnp.int32),
+            )
         return state, inbox, held, key, viol, state.commit.sum() - commit0
 
     return epoch
@@ -211,16 +241,23 @@ def build_chaos_epoch(
 
 @functools.lru_cache(maxsize=32)
 def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
-                   faultless: bool):
+                   faultless: bool, with_delay: bool = True):
     """One jitted epoch program per (cfg, spec, rounds, structure),
     shared across every run_chaos call and fault mix (probabilities are
     operands). Donation of the fleet-sized carries (state/inbox/held) is
     accelerator-only: large-C runs that compile fine otherwise die at
     runtime allocation from double-buffering, while host runs don't need
     the memory and keep maximum runtime portability."""
-    donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+    if jax.default_backend() != "cpu":
+        # held (arg 2) is None (no buffers) when the delay machinery is
+        # compiled out — donating it is at best a no-op and has crashed
+        # the tunneled TPU worker at fleet scale
+        donate = (0, 1, 2) if with_delay else (0, 1)
+    else:
+        donate = ()
     return jax.jit(
-        build_chaos_epoch(cfg, spec, rounds, faultless=faultless),
+        build_chaos_epoch(cfg, spec, rounds, faultless=faultless,
+                          with_delay=with_delay),
         donate_argnums=donate,
     )
 
@@ -244,7 +281,10 @@ def run_chaos(
     stats; raises nothing (the caller asserts)."""
     state = init_fleet(spec, C, election_tick=cfg.election_tick, seed=seed)
     inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
-    held = jax.tree.map(jnp.zeros_like, inbox)
+    # delay/reorder faults need a held buffer the size of a second inbox;
+    # delay_p=0 drops the whole machinery at trace time (1M-group tier)
+    with_delay = delay_p > 0
+    held = jax.tree.map(jnp.zeros_like, inbox) if with_delay else None
     key = jax.random.PRNGKey(seed)
     M = spec.M
     prop_len = jnp.zeros((M, C), jnp.int32)
@@ -256,8 +296,8 @@ def run_chaos(
         prop_len = prop_len.at[0].set(1)
         prop_data = prop_data.at[0, 0].set(7)
 
-    chaos = _epoch_program(cfg, spec, epoch_len, False)
-    heal = _epoch_program(cfg, spec, heal_len, True)
+    chaos = _epoch_program(cfg, spec, epoch_len, False, with_delay)
+    heal = _epoch_program(cfg, spec, heal_len, True, with_delay)
     dp = jnp.float32(drop_p)
     lp = jnp.float32(delay_p)
     pp = jnp.float32(partition_p)

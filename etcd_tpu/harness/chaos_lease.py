@@ -206,3 +206,36 @@ def run_runner_chaos(
         "runner_leaders_seen": len(leaders_seen),
         "runner_final_progress": bool(final_ok),
     }
+
+
+def main(argv=None) -> int:
+    """CLI: run both host tiers and print ONE JSON line. chaos_run.py
+    invokes this in a CPU subprocess — the tiers are host-layer tests
+    whose EtcdCluster steps would otherwise run C=1 device programs over
+    the TPU tunnel at ~3.5s per op."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    rep = run_lease_chaos(seed=args.seed)
+    rep.update(run_runner_chaos(seed=args.seed))
+    print(json.dumps(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    # force CPU before jax initialises (the sitecustomize pins the axon
+    # TPU platform otherwise)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from etcd_tpu.utils.cache import configure_compile_cache
+
+    configure_compile_cache()
+    sys.exit(main())

@@ -1,0 +1,30 @@
+"""Shared persistent-compile-cache setup for entrypoints.
+
+Every entrypoint (bench, chaos_run, test conftest, CLIs) wants the same
+thing: the repo-root ``.jax_cache`` directory with zero-threshold
+persistence. Entries are machine-specific XLA AOT code — see the
+conftest note about wiping the cache after a machine/jaxlib change.
+"""
+from __future__ import annotations
+
+import os
+
+
+def configure_compile_cache(root: str | None = None) -> str:
+    """Point jax's persistent compilation cache at <repo>/.jax_cache
+    (created if needed) and drop the size/time thresholds. Returns the
+    cache dir."""
+    import jax
+
+    if root is None:
+        import etcd_tpu
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            etcd_tpu.__file__
+        )))
+    cache = os.path.join(root, ".jax_cache")
+    os.makedirs(cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    return cache

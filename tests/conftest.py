@@ -20,7 +20,34 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: the round program is large; re-running the
 # suite should not re-pay XLA compile time.
+#
+# NOTE: cache entries are machine-specific XLA:CPU AOT code. Entries
+# compiled on a different box (or jaxlib) load with cpu_aot_loader
+# machine-feature warnings and have crashed the suite process outright
+# (SIGSEGV in the cache-read path at high RSS, round 3/4). If the suite
+# starts dying in compilation_cache.get_executable_and_time, wipe
+# .jax_cache and let it rebuild.
 os.makedirs("/root/repo/.jax_cache", exist_ok=True)
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+import gc
+
+import pytest
+
+# The single pytest process accumulates one live XLA executable per
+# compiled program (hundreds over the suite, ~10s of GB RSS). Dropping
+# them periodically bounds that growth; the persistent cache makes the
+# re-load cheap. SUITE_CLEAR_EVERY=0 disables.
+_CLEAR_EVERY = int(os.environ.get("SUITE_CLEAR_EVERY", "100"))
+_test_count = [0]
+
+
+@pytest.fixture(autouse=True)
+def _bound_executable_accumulation():
+    yield
+    _test_count[0] += 1
+    if _CLEAR_EVERY and _test_count[0] % _CLEAR_EVERY == 0:
+        jax.clear_caches()
+        gc.collect()

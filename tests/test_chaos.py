@@ -43,6 +43,21 @@ def test_chaos_small_fleet_under_faults():
     assert sum(dc for dc, _ in rep["epoch_commits"]) > 0
 
 
+def test_chaos_delay_free_program():
+    """delay_p=0 compiles the delay machinery OUT (no held buffer in the
+    scan carry — the structure the 1M-group TPU tier depends on); its
+    5-element carry and held=None plumbing must hold up in-suite, not
+    only in multi-hour TPU runs."""
+    rep = run_chaos(
+        SPEC, CFG, C=64, rounds=50, epoch_len=25, heal_len=25, seed=4,
+        drop_p=0.05, delay_p=0.0, partition_p=0.2,
+    )
+    assert_safe(rep)
+    assert rep["groups_with_leader_after_heal"] == rep["groups"]
+    assert rep["heal_commits_last_epoch"] > 0
+    assert sum(dc for dc, _ in rep["epoch_commits"]) > 0
+
+
 def test_chaos_heavy_partitions_stay_safe():
     """Aggressive partitions + drops: liveness may suffer, safety must
     not."""
