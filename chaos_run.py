@@ -127,16 +127,17 @@ def main() -> int:
             if out.returncode != 0 or not lines:
                 raise RuntimeError((out.stderr or out.stdout)[-500:])
             lrep = json.loads(lines[-1])
-            rep.update(lrep)
-            rep["lease_safe"] = (
+            lease_safe = (
                 not lrep["lease_violations"]
                 and lrep["runner_exclusion_violations"] == 0
                 and lrep["runner_final_progress"]
             )
-        except (subprocess.TimeoutExpired, json.JSONDecodeError,
-                RuntimeError) as e:
+            rep.update(lrep)
+            rep["lease_safe"] = lease_safe
+        except Exception as e:  # noqa: BLE001 — ANY tier failure must not
+            # discard the device tier's (hours-long) results
             rep["lease_safe"] = False
-            rep["lease_tier_error"] = str(e)[-500:]
+            rep["lease_tier_error"] = f"{type(e).__name__}: {e}"[-500:]
     else:
         rep["lease_safe"] = True
 

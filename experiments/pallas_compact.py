@@ -1,0 +1,133 @@
+"""Pallas TPU kernel experiment: fused inbox compaction.
+
+`compact_inbox` (models/raft.py) squeezes each node's nonempty inbox
+slots to the front: rank = cumsum(nonempty)-1 along the slot axis S,
+then a [B, S] one-hot contraction per message field. In XLA this is ~17
+separate fused reductions (one per field) sharing the recomputed rank;
+the Pallas form does ONE pass: a C-tile of every field sits in VMEM,
+rank is computed once, and all 17 outputs are written together —
+a guaranteed single HBM read+write of the inbox per round instead of
+whatever fusion split XLA picks.
+
+Standalone experiment (SURVEY §7 step 4): run on the TPU with
+    python experiments/pallas_compact.py
+and compare against the XLA form at bench shapes. Results are recorded
+in PROFILE.md; the engine adopts the kernel only if it wins.
+"""
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+S, B = 10, 4          # M*K slots in, inbox_bound out (bench geometry M=5)
+N_FIELDS = 17         # Msg leaves
+
+
+def _compact_kernel(*refs):
+    """refs = (typ_ref, f1_ref..fN_ref, out_typ_ref, out_f1..out_fN).
+    Block shapes [S, Ct] in, [B, Ct] out."""
+    n = N_FIELDS
+    typ_ref = refs[0]
+    in_refs = refs[: n + 1]
+    out_refs = refs[n + 1 :]
+    typ = typ_ref[:]                                  # [S, Ct]
+    nonempty = typ != 0
+    # rank[s] = number of nonempty slots before s (cumsum isn't lowerable
+    # on TPU Pallas yet; S is small and static, so unroll)
+    count = jnp.zeros_like(typ[0])
+    ranks = []
+    for s in range(S):
+        ranks.append(jnp.where(nonempty[s], count, -1))
+        count = count + nonempty[s].astype(jnp.int32)
+    sels = [
+        [(ranks[s] == b).astype(jnp.int32) for s in range(S)]
+        for b in range(B)
+    ]
+    for iref, oref in zip(in_refs, out_refs):
+        x = iref[:]
+        for b in range(B):
+            acc = sels[b][0] * x[0]
+            for s in range(1, S):
+                acc = acc + sels[b][s] * x[s]
+            oref[b, :] = acc
+
+
+def pallas_compact(typ, fields, ct: int = 512):
+    """typ [S, C] i32; fields: list of [S, C] i32. Returns ([B, C] typ,
+    list of [B, C])."""
+    C = typ.shape[1]
+    grid = (C // ct,)
+    in_specs = [
+        pl.BlockSpec((S, ct), lambda i: (0, i)) for _ in range(N_FIELDS + 1)
+    ]
+    out_specs = [
+        pl.BlockSpec((B, ct), lambda i: (0, i)) for _ in range(N_FIELDS + 1)
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((B, C), jnp.int32) for _ in range(N_FIELDS + 1)
+    ]
+    outs = pl.pallas_call(
+        _compact_kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+    )(typ, *fields)
+    return outs[0], list(outs[1:])
+
+
+def xla_compact(typ, fields):
+    """The engine's current form (models/raft.py compact_inbox)."""
+    nonempty = typ != 0                                   # [S, C]
+    rank = jnp.cumsum(nonempty.astype(jnp.int32), axis=0) - 1
+    sel = (
+        (rank[None] == jnp.arange(B, dtype=jnp.int32)[:, None, None])
+        & nonempty[None]
+    ).astype(jnp.int32)                                   # [B, S, C]
+    out_t = (sel * typ[None]).sum(axis=1)
+    outs = [(sel * f[None]).sum(axis=1) for f in fields]
+    return out_t, outs
+
+
+def main():
+    C = 262_144
+    key = jax.random.PRNGKey(0)
+    typ = (jax.random.uniform(key, (S, C)) < 0.4).astype(jnp.int32) * 3
+    fields = [
+        jax.random.randint(jax.random.fold_in(key, i), (S, C), 0, 1000)
+        for i in range(N_FIELDS)
+    ]
+
+    fx = jax.jit(xla_compact)
+
+    def bench(f, n=50):
+        f(typ, fields)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(typ, fields)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n * 1e3
+
+    rx = fx(typ, fields)
+    bytes_touched = (S + B) * C * 4 * (N_FIELDS + 1)
+    tx = bench(fx)
+    print(f"XLA          : {tx:.3f} ms  ({bytes_touched / tx / 1e6:.0f} GB/s)")
+    for ct in (512, 1024, 2048):
+        fp = jax.jit(functools.partial(pallas_compact, ct=ct))
+        rp = fp(typ, fields)
+        same = all(
+            jnp.array_equal(a, b)
+            for a, b in zip([rx[0]] + rx[1], [rp[0]] + rp[1])
+        )
+        tp = bench(fp)
+        print(f"Pallas ct={ct:5d}: {tp:.3f} ms  "
+              f"({bytes_touched / tp / 1e6:.0f} GB/s)  identical={same}  "
+              f"speedup={tx / tp:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

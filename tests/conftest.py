@@ -27,10 +27,11 @@ jax.config.update("jax_platforms", "cpu")
 # (SIGSEGV in the cache-read path at high RSS, round 3/4). If the suite
 # starts dying in compilation_cache.get_executable_and_time, wipe
 # .jax_cache and let it rebuild.
-os.makedirs("/root/repo/.jax_cache", exist_ok=True)
-jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+from etcd_tpu.utils.cache import configure_compile_cache  # noqa: E402
+
+configure_compile_cache(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
 
 import gc
 
