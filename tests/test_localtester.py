@@ -1,0 +1,19 @@
+"""Local tester (tools/local-tester analog): fault-injected live cluster
+under client load — drops, isolation, partitions, crash+restart — with
+post-heal verification of every acknowledged write."""
+from etcd_tpu.localtester import run_local_tester
+
+
+def test_local_tester_memory_cluster():
+    rep = run_local_tester(cycles=3, seed=2, puts_per_phase=4)
+    assert rep["healthy"], rep
+    assert rep["puts_ok"] > 0
+    assert set(rep["faults"]) <= {"drop_links", "isolate_member",
+                                  "partition"}
+
+
+def test_local_tester_crash_restart_cycle(tmp_path):
+    rep = run_local_tester(cycles=4, seed=3, puts_per_phase=4,
+                           data_dir=str(tmp_path))
+    assert rep["healthy"], rep
+    assert "crash_restart" in rep["faults"]
