@@ -1,0 +1,375 @@
+"""v2store unit tests — behavior pinned to server/etcdserver/api/v2store
+store_test.go / store_ttl_test.go / watcher_test.go scenarios."""
+import pytest
+
+from etcd_tpu.server.v2store import (
+    EcodeDirNotEmpty,
+    EcodeEventIndexCleared,
+    EcodeKeyNotFound,
+    EcodeNodeExist,
+    EcodeNotDir,
+    EcodeNotFile,
+    EcodeRootROnly,
+    EcodeTestFailed,
+    V2Error,
+    V2Store,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def s():
+    return V2Store(clock=FakeClock())
+
+
+def code(excinfo) -> int:
+    return excinfo.value.code
+
+
+# ------------------------------------------------------------- basic ops
+
+def test_create_and_get(s):
+    e = s.create("/foo", value="bar")
+    assert e.action == "create"
+    assert e.node["key"] == "/foo"
+    assert e.node["value"] == "bar"
+    assert e.node["createdIndex"] == 1
+    assert e.etcd_index == 1
+    g = s.get("/foo")
+    assert g.action == "get"
+    assert g.node["value"] == "bar"
+    assert g.etcd_index == 1
+
+
+def test_create_exists_fails(s):
+    s.create("/foo", value="bar")
+    with pytest.raises(V2Error) as ei:
+        s.create("/foo", value="baz")
+    assert code(ei) == EcodeNodeExist
+
+
+def test_create_intermediate_dirs(s):
+    e = s.create("/a/b/c", value="v")
+    assert e.node["key"] == "/a/b/c"
+    g = s.get("/a", recursive=True)
+    assert g.node["dir"] is True
+    assert g.node["nodes"][0]["key"] == "/a/b"
+
+
+def test_create_through_file_fails(s):
+    s.create("/f", value="v")
+    with pytest.raises(V2Error) as ei:
+        s.create("/f/child", value="v")
+    assert code(ei) == EcodeNotDir
+
+
+def test_get_missing(s):
+    with pytest.raises(V2Error) as ei:
+        s.get("/nope")
+    assert code(ei) == EcodeKeyNotFound
+    assert ei.value.cause == "/nope"
+
+
+def test_get_dir_sorted_hides_hidden(s):
+    s.create("/d", dir=True)
+    s.create("/d/z", value="1")
+    s.create("/d/a", value="2")
+    s.create("/d/_hidden", value="3")
+    g = s.get("/d", recursive=True, sorted_=True)
+    keys = [n["key"] for n in g.node["nodes"]]
+    assert keys == ["/d/a", "/d/z"]  # sorted, hidden skipped
+
+
+def test_set_creates_then_replaces(s):
+    e1 = s.set("/foo", value="v1")
+    assert e1.action == "set"
+    assert e1.prev_node is None
+    assert e1.is_created()
+    e2 = s.set("/foo", value="v2")
+    assert e2.prev_node["value"] == "v1"
+    assert not e2.is_created()
+    assert e2.node["modifiedIndex"] == 2
+    assert e2.node["createdIndex"] == 2  # set replaces the node
+
+
+def test_set_on_dir_fails(s):
+    s.create("/d", dir=True)
+    with pytest.raises(V2Error) as ei:
+        s.set("/d", value="v")
+    assert code(ei) == EcodeNotFile
+
+
+def test_update_value_keeps_created_index(s):
+    s.create("/foo", value="v1")
+    e = s.update("/foo", "v2")
+    assert e.action == "update"
+    assert e.node["createdIndex"] == 1
+    assert e.node["modifiedIndex"] == 2
+    assert e.prev_node["value"] == "v1"
+
+
+def test_update_missing_and_dir(s):
+    with pytest.raises(V2Error) as ei:
+        s.update("/nope", "v")
+    assert code(ei) == EcodeKeyNotFound
+    s.create("/d", dir=True)
+    with pytest.raises(V2Error) as ei:
+        s.update("/d", "")
+    assert code(ei) == EcodeNotFile
+
+
+def test_root_read_only(s):
+    for fn in (lambda: s.set("/", value="v"),
+               lambda: s.delete("/", dir=True, recursive=True),
+               lambda: s.update("/", "v"),
+               lambda: s.compare_and_swap("/", "", 0, "v")):
+        with pytest.raises(V2Error) as ei:
+            fn()
+        assert code(ei) == EcodeRootROnly
+
+
+def test_delete_file_and_dir(s):
+    s.create("/foo", value="v")
+    e = s.delete("/foo")
+    assert e.action == "delete"
+    assert e.prev_node["value"] == "v"
+    s.create("/d/x", value="v")
+    with pytest.raises(V2Error) as ei:
+        s.delete("/d")  # dir without dir flag
+    assert code(ei) == EcodeNotFile
+    with pytest.raises(V2Error) as ei:
+        s.delete("/d", dir=True)  # non-empty without recursive
+    assert code(ei) == EcodeDirNotEmpty
+    e = s.delete("/d", recursive=True)  # recursive implies dir
+    assert e.node["dir"] is True
+    with pytest.raises(V2Error):
+        s.get("/d/x")
+
+
+def test_cas(s):
+    s.create("/foo", value="v1")
+    e = s.compare_and_swap("/foo", "v1", 0, "v2")
+    assert e.action == "compareAndSwap"
+    assert e.node["value"] == "v2"
+    with pytest.raises(V2Error) as ei:
+        s.compare_and_swap("/foo", "bad", 0, "v3")
+    assert code(ei) == EcodeTestFailed
+    assert "[bad != v2]" in ei.value.cause
+    with pytest.raises(V2Error) as ei:
+        s.compare_and_swap("/foo", "", 999, "v3")
+    assert code(ei) == EcodeTestFailed
+    assert "[999 != 2]" in ei.value.cause
+
+
+def test_cas_both_wildcards_swap(s):
+    s.create("/foo", value="v1")
+    e = s.compare_and_swap("/foo", "", 0, "v2")
+    assert e.node["value"] == "v2"
+
+
+def test_cad(s):
+    s.create("/foo", value="v1")
+    with pytest.raises(V2Error) as ei:
+        s.compare_and_delete("/foo", "bad", 0)
+    assert code(ei) == EcodeTestFailed
+    e = s.compare_and_delete("/foo", "v1", 0)
+    assert e.action == "compareAndDelete"
+    with pytest.raises(V2Error):
+        s.get("/foo")
+    s.create("/d", dir=True)
+    with pytest.raises(V2Error) as ei:
+        s.compare_and_delete("/d", "", 0)
+    assert code(ei) == EcodeNotFile
+
+
+def test_create_in_order(s):
+    s.create("/q", dir=True)
+    e1 = s.create("/q", unique=True, value="a")
+    e2 = s.create("/q", unique=True, value="b")
+    k1, k2 = e1.node["key"], e2.node["key"]
+    assert k1 < k2  # zero-padded index names sort in creation order
+    assert k1.split("/")[-1] == format(2, "020d")
+    g = s.get("/q", recursive=True, sorted_=True)
+    assert [n["value"] for n in g.node["nodes"]] == ["a", "b"]
+
+
+# --------------------------------------------------------------- TTL
+
+def test_ttl_expire(s):
+    clk = s.clock
+    s.create("/foo", value="v", expire_time=clk.t + 5)
+    g = s.get("/foo")
+    assert g.node["ttl"] == 5
+    clk.advance(3)
+    assert s.get("/foo").node["ttl"] == 2
+    s.delete_expired_keys(clk.t)
+    assert s.get("/foo").node["value"] == "v"  # not yet
+    clk.advance(3)
+    s.delete_expired_keys(clk.t)
+    with pytest.raises(V2Error) as ei:
+        s.get("/foo")
+    assert code(ei) == EcodeKeyNotFound
+    assert s.stats.counters["expireCount"] == 1
+
+
+def test_ttl_update_to_permanent(s):
+    clk = s.clock
+    s.create("/foo", value="v", expire_time=clk.t + 5)
+    s.update("/foo", "v2")  # no TTL in update → becomes permanent
+    clk.advance(10)
+    s.delete_expired_keys(clk.t)
+    assert s.get("/foo").node["value"] == "v2"
+    assert not s.has_ttl_keys()
+
+
+def test_ttl_refresh_keeps_value(s):
+    clk = s.clock
+    s.create("/foo", value="v", expire_time=clk.t + 2)
+    e = s.update("/foo", "", expire_time=clk.t + 100, refresh=True)
+    assert e.refresh
+    assert s.get("/foo").node["value"] == "v"  # refresh keeps value
+    clk.advance(50)
+    s.delete_expired_keys(clk.t)
+    assert s.get("/foo").node["value"] == "v"
+
+
+def test_expire_dir_notifies_inner_watcher(s):
+    clk = s.clock
+    s.create("/d", dir=True, expire_time=clk.t + 1)
+    s.create("/d/k", value="v")
+    w = s.watch("/d/k")
+    clk.advance(2)
+    s.delete_expired_keys(clk.t)
+    ev = w.poll()
+    assert ev is not None
+    assert ev.action == "expire"
+
+
+# --------------------------------------------------------------- watch
+
+def test_watch_future_event(s):
+    w = s.watch("/foo")
+    assert w.poll() is None
+    s.create("/foo", value="v")
+    ev = w.poll()
+    assert ev.action == "create"
+    assert ev.node["key"] == "/foo"
+    # one-shot watcher: removed after firing
+    s.set("/foo", value="v2")
+    assert w.poll() is None
+
+
+def test_watch_from_history(s):
+    s.create("/foo", value="v1")
+    s.set("/foo", value="v2")
+    w = s.watch("/foo", since_index=1)
+    ev = w.poll()
+    assert ev.node["modifiedIndex"] == 1
+    assert ev.action == "create"
+
+
+def test_watch_recursive(s):
+    w = s.watch("/d", recursive=True, stream=True)
+    s.create("/d/a", value="1")
+    s.create("/d/b", value="2")
+    assert w.poll().node["key"] == "/d/a"
+    assert w.poll().node["key"] == "/d/b"
+
+
+def test_watch_hidden_not_notified(s):
+    w = s.watch("/d", recursive=True, stream=True)
+    s.create("/d/_secret", value="1")
+    assert w.poll() is None
+    # but watching the hidden path directly works
+    w2 = s.watch("/d/_secret")
+    s.set("/d/_secret", value="2")
+    assert w2.poll() is not None
+
+
+def test_watch_delete_dir_notifies_children_watchers(s):
+    s.create("/d/k", value="v")
+    w = s.watch("/d/k")
+    s.delete("/d", recursive=True)
+    ev = w.poll()
+    assert ev.action == "delete"
+
+
+def test_watch_index_cleared(s):
+    for i in range(1, 1100):
+        s.set(f"/k{i}", value="v")
+    with pytest.raises(V2Error) as ei:
+        s.watch("/k1", since_index=1)
+    assert code(ei) == EcodeEventIndexCleared
+
+
+def test_watch_history_scan_recursive_prefix(s):
+    s.create("/d/sub/x", value="v")
+    w = s.watch("/d", recursive=True, since_index=1)
+    ev = w.poll()
+    assert ev.node["key"] == "/d/sub/x"
+
+
+# ------------------------------------------------- persistence / clone
+
+def test_save_recovery_roundtrip(s):
+    clk = s.clock
+    s.create("/a/b", value="v1")
+    s.create("/ttl", value="v2", expire_time=clk.t + 5)
+    s.create("/d", dir=True)
+    blob = s.save()
+    s2 = V2Store(clock=clk)
+    s2.recovery(blob)
+    assert s2.index() == s.index()
+    assert s2.get("/a/b").node["value"] == "v1"
+    assert s2.get("/ttl").node["ttl"] == 5
+    assert s2.has_ttl_keys()
+    clk.advance(10)
+    s2.delete_expired_keys(clk.t)
+    with pytest.raises(V2Error):
+        s2.get("/ttl")
+    assert s2.get("/a/b").node["value"] == "v1"
+
+
+def test_clone_independent(s):
+    s.create("/foo", value="v")
+    c = s.clone()
+    s.set("/foo", value="v2")
+    assert c.get("/foo").node["value"] == "v"
+    assert c.index() == 1
+
+
+def test_json_stats(s):
+    s.create("/foo", value="v")
+    with pytest.raises(V2Error):
+        s.get("/nope")
+    st = s.json_stats()
+    assert st["createSuccess"] == 1
+    assert st["getsFail"] == 1
+
+
+def test_namespaces_readonly():
+    s = V2Store(namespaces=("/0", "/1"))
+    assert s.get("/0").node["dir"] is True
+    with pytest.raises(V2Error) as ei:
+        s.set("/0", value="v")
+    assert code(ei) == EcodeRootROnly
+    s.set("/0/key", value="v")  # children are writable
+
+
+def test_event_index_semantics(s):
+    """EtcdIndex on reads = store index at read time, not node index."""
+    s.create("/a", value="1")
+    s.create("/b", value="2")
+    g = s.get("/a")
+    assert g.etcd_index == 2
+    assert g.node["modifiedIndex"] == 1
