@@ -38,6 +38,7 @@ from etcd_tpu.server.version import (
     detect_downgrade,
     major_minor,
 )
+from etcd_tpu.server.v2store import V2Store
 from etcd_tpu.server.watch import WatchableStore
 from etcd_tpu.types import ENTRY_CONF_CHANGE, NONE_ID, ROLE_LEADER
 
@@ -143,6 +144,9 @@ class MemberState:
     server_version: str = SERVER_VERSION
     cluster_version: str | None = None
     downgrade: DowngradeInfo = dataclasses.field(default_factory=DowngradeInfo)
+    # legacy v2 applied state machine (api/v2store), mutated only by
+    # committed kind="v2" entries (the applyV2Request path, apply_v2.go)
+    v2store: "V2Store" = dataclasses.field(default_factory=lambda: V2Store())
 
 
 class EtcdCluster:
@@ -181,6 +185,12 @@ class EtcdCluster:
         # (the reference's rolling binary swap); applies at construction
         # AND at restart-from-disk (see _member_from_backend)
         self.server_versions: dict[int, str] = {}
+        # wall clock for v2 TTL stamping (injectable for deterministic
+        # TTL tests; replicated state never reads it directly — only
+        # propose-time stamps do)
+        import time as _time
+
+        self.v2_now = _time.time
         self.members = [
             MemberState(WatchableStore(), Lessor(lease_min_ttl),
                         self._new_auth())
@@ -376,6 +386,7 @@ class EtcdCluster:
             alarms=ms.alarms,
             cluster_version=ms.cluster_version,
             downgrade=ms.downgrade.to_dict(),
+            v2=ms.v2store.save(),
         )
         # sig records success only after the batch is fully staged: a crash
         # at any marker above re-stages the whole batch on the next pump
@@ -484,6 +495,8 @@ class EtcdCluster:
             ms.server_version = self.server_versions[m]
         ms.cluster_version = meta.get("cluster_version")
         ms.downgrade = DowngradeInfo.from_dict(meta.get("downgrade"))
+        if meta.get("v2"):
+            ms.v2store.recovery(meta["v2"])
         detect_downgrade(ms.server_version, ms.cluster_version, ms.downgrade)
         return ms, meta
 
@@ -642,6 +655,9 @@ class EtcdCluster:
             # versions_match_target (and so monitor_downgrade) forever
             "cluster_version": ms.cluster_version,
             "downgrade": ms.downgrade.to_dict(),
+            # v2 tree rides the snapshot like the reference's v2store
+            # snap (server.go snapshot() marshals the v2 store)
+            "v2": ms.v2store.save(),
         }
 
     def restore_member(self, m: int, snap: dict) -> None:
@@ -655,6 +671,8 @@ class EtcdCluster:
         ms.applied_index = snap["applied_index"]
         ms.cluster_version = snap.get("cluster_version")
         ms.downgrade = DowngradeInfo.from_dict(snap.get("downgrade"))
+        if snap.get("v2"):
+            ms.v2store.recovery(snap["v2"])
         ms.results.clear()
 
     def _gc_requests(self) -> None:
@@ -749,9 +767,60 @@ class EtcdCluster:
                 req.get("ver", ""), bool(req["enabled"])
             )
             return ms.downgrade.enabled
+        if kind == "v2":
+            return self._apply_v2(ms, req)
         if kind.startswith("auth_"):
             return self._apply_auth(ms, kind, req)
         raise ServerError(f"unknown request kind {kind}")
+
+    def _apply_v2(self, ms: MemberState, req: dict):
+        """applyV2Request (apply_v2.go:124-148): interpret a committed
+        RequestV2 as a v2store call. TTLs arrive as absolute expirations
+        stamped at propose time (RequestV2.Expiration) so every member's
+        tree — including its TTL heap — is bit-identical."""
+        st = ms.v2store
+        method = req["method"]
+        if method == "SYNC":  # pathless: just an expiry cutoff
+            st.delete_expired_keys(req["time"])
+            return None
+        path = req["path"]
+        exp = req.get("expiration")
+        refresh = bool(req.get("refresh"))
+        if method == "POST":
+            return st.create(path, req.get("dir", False),
+                             req.get("val", ""), unique=True,
+                             expire_time=exp)
+        if method == "PUT":
+            pv, pi = req.get("prev_value", ""), req.get("prev_index", 0)
+            pe = req.get("prev_exist")
+            if pe is not None:
+                if pe:
+                    if pi == 0 and pv == "":
+                        return st.update(path, req.get("val", ""),
+                                         expire_time=exp, refresh=refresh)
+                    return st.compare_and_swap(path, pv, pi,
+                                              req.get("val", ""),
+                                              expire_time=exp,
+                                              refresh=refresh)
+                return st.create(path, req.get("dir", False),
+                                 req.get("val", ""), unique=False,
+                                 expire_time=exp)
+            if pi > 0 or pv != "":
+                return st.compare_and_swap(path, pv, pi,
+                                          req.get("val", ""),
+                                          expire_time=exp, refresh=refresh)
+            return st.set(path, req.get("dir", False), req.get("val", ""),
+                          expire_time=exp, refresh=refresh)
+        if method == "DELETE":
+            pv, pi = req.get("prev_value", ""), req.get("prev_index", 0)
+            if pi > 0 or pv != "":
+                return st.compare_and_delete(path, pv, pi)
+            return st.delete(path, req.get("dir", False),
+                             req.get("recursive", False))
+        if method == "QGET":
+            return st.get(path, req.get("recursive", False),
+                          req.get("sorted", False))
+        raise ServerError(f"unknown v2 method {method}")
 
     def _check_quota(self, ms: MemberState) -> None:
         if "NOSPACE" in ms.alarms:
@@ -1095,6 +1164,52 @@ class EtcdCluster:
         return self.members[member].store.cancel(watch_id)
 
     # ------------------------------------------------------------ membership
+    # ------------------------------------------------------------ v2 API
+    # the v2 request front (v2_server.go): every mutation — and QGET, the
+    # quorum read — is ordered through consensus; plain gets are served
+    # from the serving member's applied tree (the v2 "serializable" read)
+
+    def v2_request(self, method: str, path: str, *, val: str = "",
+                   dir: bool = False, prev_value: str = "",
+                   prev_index: int = 0, prev_exist: bool | None = None,
+                   recursive: bool = False, sorted_: bool = False,
+                   refresh: bool = False, ttl: int | None = None,
+                   member: int | None = None):
+        req: dict[str, Any] = {
+            "kind": "v2", "method": method, "path": path, "val": val,
+            "dir": dir, "prev_value": prev_value,
+            "prev_index": prev_index, "prev_exist": prev_exist,
+            "recursive": recursive, "sorted": sorted_, "refresh": refresh,
+        }
+        if ttl is not None:
+            # RequestV2.Expiration: absolute, stamped at propose time so
+            # the apply is identical on every member (client.go:496-523)
+            req["expiration"] = self.v2_now() + ttl
+        return self._propose(req, member=member)
+
+    def v2_get(self, path: str, recursive: bool = False,
+               sorted_: bool = False, member: int | None = None):
+        m = member if member is not None else self.ensure_leader()
+        return self.members[m].v2store.get(path, recursive, sorted_)
+
+    def v2_sync(self, member: int | None = None) -> None:
+        """The SYNC proposal (etcdserver sync): the serving member's
+        clock decides the expiry cutoff, consensus orders it, every
+        member expires the same keys."""
+        self._propose({"kind": "v2", "method": "SYNC",
+                       "time": self.v2_now()}, member=member)
+
+    def v2_watch(self, path: str, recursive: bool = False,
+                 stream: bool = False, since_index: int = 0,
+                 member: int | None = None):
+        m = member if member is not None else self.ensure_leader()
+        return self.members[m].v2store.watch(path, recursive, stream,
+                                             since_index)
+
+    def v2_stats(self, member: int | None = None) -> dict:
+        m = member if member is not None else self.ensure_leader()
+        return self.members[m].v2store.json_stats()
+
     def member_config(self) -> HostConfig:
         """Current config from the leader's applied masks."""
         s = self.cl.s

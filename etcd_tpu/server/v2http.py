@@ -1,0 +1,241 @@
+"""The v2 REST façade — /v2/keys, /v2/members, /v2/stats.
+
+Re-design of ``server/etcdserver/api/v2http`` (client.go keysHandler +
+parseKeyRequest:346-527, membersHandler, statsHandler) for this
+framework's gateway: requests arrive as (method, path, form) triples —
+from the JSON/query HTTP server or in-process from clientv2 — and are
+parsed with the reference's exact validation ladder and error codes,
+then routed through :class:`EtcdCluster`'s consensus front (writes and
+quorum reads) or served from the applied tree (plain reads).
+
+Watch (GET ?wait=true) follows this gateway's long-poll convention (see
+server/v3rpc.py's watch): if the event is already in history it returns
+immediately; otherwise the watcher parks in a registry and the client
+polls ``watch_poll`` — the blocking-HTTP analog collapsed to polling,
+like the v3 façade's JSON long-poll stands in for gRPC streams.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from etcd_tpu.models.changer import ConfChangeError
+from etcd_tpu.server.kvserver import EtcdCluster, ServerError
+from etcd_tpu.server.v2store import (
+    EcodeIndexNaN,
+    EcodeInvalidField,
+    EcodePrevValueRequired,
+    EcodeRaftInternal,
+    EcodeRefreshTTLRequired,
+    EcodeRefreshValue,
+    EcodeTTLNaN,
+    Event,
+    V2Error,
+)
+
+KEYS_PREFIX = "/v2/keys"
+
+
+def _get_bool(form: dict, name: str) -> bool:
+    """getBool (v2http/http.go): absent = false, 'true'/'false' only."""
+    v = form.get(name)
+    if v is None:
+        return False
+    if isinstance(v, bool):
+        return v
+    if v == "true":
+        return True
+    if v == "false":
+        return False
+    raise V2Error(EcodeInvalidField, f'invalid value for "{name}"')
+
+
+def _get_uint(form: dict, name: str, code: int) -> int:
+    v = form.get(name)
+    if v is None or v == "":
+        return 0
+    try:
+        i = int(v)
+        if i < 0:
+            raise ValueError
+        return i
+    except (TypeError, ValueError):
+        raise V2Error(code, f'invalid value for "{name}"') from None
+
+
+def parse_key_request(method: str, form: dict) -> dict:
+    """parseKeyRequest (v2http/client.go:346-527): the validation ladder,
+    same codes, same order. Returns the RequestV2-shaped dict."""
+    prev_index = _get_uint(form, "prevIndex", EcodeIndexNaN)
+    wait_index = _get_uint(form, "waitIndex", EcodeIndexNaN)
+    recursive = _get_bool(form, "recursive")
+    sorted_ = _get_bool(form, "sorted")
+    wait = _get_bool(form, "wait")
+    dir_ = _get_bool(form, "dir")
+    quorum = _get_bool(form, "quorum")
+    stream = _get_bool(form, "stream")
+    if wait and method != "GET":
+        raise V2Error(EcodeInvalidField,
+                      '"wait" can only be used with GET requests')
+    prev_value = form.get("prevValue", "")
+    if "prevValue" in form and prev_value == "":
+        raise V2Error(EcodePrevValueRequired,
+                      '"prevValue" cannot be empty')
+    no_value_on_success = _get_bool(form, "noValueOnSuccess")
+    ttl = None
+    if form.get("ttl") not in (None, ""):
+        ttl = _get_uint(form, "ttl", EcodeTTLNaN)
+    prev_exist = None
+    if "prevExist" in form:
+        prev_exist = _get_bool(form, "prevExist")
+    refresh = None
+    if "refresh" in form:
+        refresh = _get_bool(form, "refresh")
+        if refresh:
+            if form.get("value"):
+                raise V2Error(EcodeRefreshValue,
+                              "A value was provided on a refresh")
+            if ttl is None:
+                raise V2Error(EcodeRefreshTTLRequired, "No TTL value set")
+    return {
+        "method": method, "value": form.get("value", ""), "dir": dir_,
+        "prev_value": prev_value, "prev_index": prev_index,
+        "prev_exist": prev_exist, "wait": wait, "wait_index": wait_index,
+        "recursive": recursive, "sorted": sorted_, "quorum": quorum,
+        "stream": stream, "refresh": bool(refresh), "ttl": ttl,
+        "no_value_on_success": no_value_on_success,
+    }
+
+
+class V2Api:
+    """keysHandler + membersHandler + statsHandler over EtcdCluster."""
+
+    def __init__(self, ec: EtcdCluster):
+        self.ec = ec
+        self._watches: dict[int, Any] = {}
+        self._next_watch = 1
+
+    # ------------------------------------------------------------- keys
+    def keys(self, method: str, key: str,
+             form: dict | None = None) -> tuple[int, dict, dict]:
+        """One /v2/keys request. Returns (status, body, headers)."""
+        form = form or {}
+        try:
+            r = parse_key_request(method, form)
+            if method == "GET":
+                return self._get(key, r)
+            if method in ("PUT", "POST", "DELETE"):
+                ev = self.ec.v2_request(
+                    method, key, val=r["value"], dir=r["dir"],
+                    prev_value=r["prev_value"],
+                    prev_index=r["prev_index"],
+                    prev_exist=r["prev_exist"],
+                    recursive=r["recursive"], sorted_=r["sorted"],
+                    refresh=r["refresh"], ttl=r["ttl"])
+                return self._key_event(ev, r)
+            raise V2Error(EcodeInvalidField, f"bad method {method}")
+        except V2Error as e:
+            return e.status_code(), e.to_json(), self._headers()
+        except ServerError as e:
+            err = V2Error(EcodeRaftInternal, str(e),
+                          self._store().current_index)
+            return err.status_code(), err.to_json(), self._headers()
+
+    def _store(self):
+        return self.ec.members[self.ec.ensure_leader()].v2store
+
+    def _headers(self) -> dict:
+        st = self._store()
+        return {"X-Etcd-Index": st.current_index}
+
+    def _key_event(self, ev: Event, r: dict) -> tuple[int, dict, dict]:
+        # writeKeyEvent: 201 on create, else 200; noValueOnSuccess trims
+        status = 201 if ev.is_created() else 200
+        body = ev.to_json()
+        if r.get("no_value_on_success"):
+            body = dict(body)
+            node = dict(body["node"])
+            node.pop("value", None)
+            node.pop("nodes", None)
+            body["node"] = node
+            body.pop("prevNode", None)
+        return status, body, self._headers()
+
+    def _get(self, key: str, r: dict) -> tuple[int, dict, dict]:
+        if r["wait"]:
+            return self._watch(key, r)
+        if r["quorum"]:
+            ev = self.ec.v2_request("QGET", key, recursive=r["recursive"],
+                                    sorted_=r["sorted"])
+        else:
+            ev = self.ec.v2_get(key, r["recursive"], r["sorted"])
+        return 200, ev.to_json(), self._headers()
+
+    def _watch(self, key: str, r: dict) -> tuple[int, dict, dict]:
+        w = self.ec.v2_watch(key, recursive=r["recursive"],
+                             stream=r["stream"],
+                             since_index=r["wait_index"])
+        ev = w.poll()
+        if ev is not None and not r["stream"]:
+            w.remove()
+            return 200, ev.to_json(), self._headers()
+        wid = self._next_watch
+        self._next_watch += 1
+        self._watches[wid] = w
+        out: dict[str, Any] = {"watch_id": wid}
+        if ev is not None:  # stream watcher with a ready history event
+            out["event"] = ev.to_json()
+        return 200, out, self._headers()
+
+    def watch_poll(self, watch_id: int) -> tuple[int, dict, dict]:
+        w = self._watches.get(watch_id)
+        if w is None:
+            return 404, {"error": "unknown watch"}, self._headers()
+        ev = w.poll()
+        if ev is None:
+            return 200, {}, self._headers()
+        if not w.stream:
+            w.remove()
+            del self._watches[watch_id]
+        return 200, {"event": ev.to_json()}, self._headers()
+
+    def watch_cancel(self, watch_id: int) -> None:
+        w = self._watches.pop(watch_id, None)
+        if w is not None:
+            w.remove()
+
+    # ---------------------------------------------------------- members
+    def members(self, method: str, suffix: str = "",
+                form: dict | None = None) -> tuple[int, dict, dict]:
+        form = form or {}
+        try:
+            if method == "GET":
+                cfg = self.ec.member_config()
+                return 200, {"members": [
+                    {"id": str(i), "name": f"member{i}",
+                     "isLearner": i in cfg.learners}
+                    for i in sorted(cfg.progress)
+                ]}, self._headers()
+            if method == "POST":
+                mid = int(form["id"])
+                self.ec.member_add(mid,
+                                   learner=bool(form.get("isLearner")))
+                return 201, {"id": str(mid)}, self._headers()
+            if method == "DELETE":
+                self.ec.member_remove(int(suffix.strip("/")))
+                return 204, {}, self._headers()
+            return 405, {"error": "method not allowed"}, self._headers()
+        except (ServerError, ConfChangeError, ValueError, KeyError) as e:
+            return 500, {"message": str(e)}, self._headers()
+
+    # ------------------------------------------------------------ stats
+    def stats(self, which: str) -> tuple[int, dict, dict]:
+        if which == "store":
+            return 200, self.ec.v2_stats(), self._headers()
+        if which == "self":
+            lead = self.ec.ensure_leader()
+            return 200, {"id": str(lead), "state": "StateLeader"}, \
+                self._headers()
+        if which == "leader":
+            lead = self.ec.ensure_leader()
+            return 200, {"leader": str(lead)}, self._headers()
+        return 404, {"error": f"unknown stats {which}"}, self._headers()

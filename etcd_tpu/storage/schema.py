@@ -89,6 +89,7 @@ def save_applied_meta(
     be: Backend, *, index: int, term: int, store: MVCCStore,
     lease_snap, auth_snap, alarms,
     cluster_version: str | None = None, downgrade: dict | None = None,
+    v2: str | None = None,
 ) -> None:
     """One record = consistent index + MVCC cursors + the small applied
     sub-states (lease/auth/alarm buckets of the reference schema, plus
@@ -108,6 +109,7 @@ def save_applied_meta(
                 "alarms": sorted(alarms),
                 "cluster_version": cluster_version,
                 "downgrade": downgrade,
+                "v2": v2,
             },
             protocol=4,
         ),
