@@ -1,0 +1,272 @@
+"""clientv2 — the legacy v2 client (client/v2 analog).
+
+Mirrors ``client/v2``'s surface (keys.go KeysAPI: Get/Set/Delete/Create/
+CreateInOrder/Update/Watcher with the PrevExist tri-state; members.go
+MembersAPI) over the in-process :class:`V2Api` gateway, the same way
+``client.py`` wraps the v3 surface. Transport-level balancing/retry
+collapses away in-process; ``Error`` carries the server's v2 error code
+exactly like client/v2's Error type.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from etcd_tpu.server.v2http import V2Api
+
+# PrevExist tri-state (keys.go PrevExistType)
+PREV_IGNORE = None
+PREV_EXIST = True
+PREV_NO_EXIST = False
+
+
+class Error(Exception):
+    """client/v2 Error: the server's v2 error payload, client-side."""
+
+    def __init__(self, code: int, message: str, cause: str, index: int):
+        self.code = code
+        self.message = message
+        self.cause = cause
+        self.index = index
+        super().__init__(f"{code}: {message} ({cause}) [{index}]")
+
+    @classmethod
+    def from_json(cls, j: dict) -> "Error":
+        return cls(j.get("errorCode", 0), j.get("message", ""),
+                   j.get("cause", ""), j.get("index", 0))
+
+
+class Response:
+    """keys.go Response: action + node + prevNode + cluster index."""
+
+    __slots__ = ("action", "node", "prev_node", "index")
+
+    def __init__(self, body: dict, headers: dict):
+        self.action = body.get("action")
+        self.node = body.get("node")
+        self.prev_node = body.get("prevNode")
+        self.index = headers.get("X-Etcd-Index", 0)
+
+
+def _unwrap(res: tuple[int, dict, dict]) -> Response:
+    status, body, headers = res
+    if "errorCode" in body:
+        raise Error.from_json(body)
+    if status >= 400:
+        raise Error(0, body.get("error", body.get("message", "")), "",
+                    headers.get("X-Etcd-Index", 0))
+    return Response(body, headers)
+
+
+class Watcher:
+    """keys.go watcher: next() polls the gateway's parked watch."""
+
+    def __init__(self, api: V2Api, first: dict | None, watch_id: int | None,
+                 headers: dict):
+        self.api = api
+        self._first = first
+        self.watch_id = watch_id
+        self._headers = headers
+
+    def next(self) -> Response | None:
+        """One event if available, else None (the long-poll read)."""
+        if self._first is not None:
+            ev, self._first = self._first, None
+            return Response(ev, self._headers)
+        if self.watch_id is None:
+            return None
+        status, body, headers = self.api.watch_poll(self.watch_id)
+        if "errorCode" in body:
+            raise Error.from_json(body)
+        if "event" not in body:
+            return None
+        return Response(body["event"], headers)
+
+    def cancel(self) -> None:
+        if self.watch_id is not None:
+            self.api.watch_cancel(self.watch_id)
+            self.watch_id = None
+
+
+class KeysAPI:
+    """client/v2 KeysAPI over the gateway."""
+
+    def __init__(self, api: V2Api):
+        self.api = api
+
+    def get(self, key: str, recursive: bool = False, sort: bool = False,
+            quorum: bool = False) -> Response:
+        form: dict[str, Any] = {}
+        if recursive:
+            form["recursive"] = "true"
+        if sort:
+            form["sorted"] = "true"
+        if quorum:
+            form["quorum"] = "true"
+        return _unwrap(self.api.keys("GET", key, form))
+
+    def set(self, key: str, value: str | None = None, *,
+            prev_value: str = "", prev_index: int = 0,
+            prev_exist: bool | None = PREV_IGNORE,
+            ttl: int | None = None, refresh: bool = False,
+            dir: bool = False,
+            no_value_on_success: bool = False) -> Response:
+        form: dict[str, Any] = {}
+        if value is not None:
+            form["value"] = value
+        if prev_value:
+            form["prevValue"] = prev_value
+        if prev_index:
+            form["prevIndex"] = str(prev_index)
+        if prev_exist is not PREV_IGNORE:
+            form["prevExist"] = "true" if prev_exist else "false"
+        if ttl is not None:
+            form["ttl"] = str(ttl)
+        if refresh:
+            form["refresh"] = "true"
+        if dir:
+            form["dir"] = "true"
+        if no_value_on_success:
+            form["noValueOnSuccess"] = "true"
+        return _unwrap(self.api.keys("PUT", key, form))
+
+    def create(self, key: str, value: str,
+               ttl: int | None = None) -> Response:
+        return self.set(key, value, prev_exist=PREV_NO_EXIST, ttl=ttl)
+
+    def create_in_order(self, dir_key: str, value: str,
+                        ttl: int | None = None) -> Response:
+        form: dict[str, Any] = {"value": value}
+        if ttl is not None:
+            form["ttl"] = str(ttl)
+        return _unwrap(self.api.keys("POST", dir_key, form))
+
+    def update(self, key: str, value: str) -> Response:
+        return self.set(key, value, prev_exist=PREV_EXIST)
+
+    def delete(self, key: str, *, prev_value: str = "",
+               prev_index: int = 0, recursive: bool = False,
+               dir: bool = False) -> Response:
+        form: dict[str, Any] = {}
+        if prev_value:
+            form["prevValue"] = prev_value
+        if prev_index:
+            form["prevIndex"] = str(prev_index)
+        if recursive:
+            form["recursive"] = "true"
+        if dir:
+            form["dir"] = "true"
+        return _unwrap(self.api.keys("DELETE", key, form))
+
+    def watcher(self, key: str, *, after_index: int = 0,
+                recursive: bool = False) -> Watcher:
+        form: dict[str, Any] = {"wait": "true", "stream": "true"}
+        if after_index:
+            # WatcherOptions.AfterIndex: watch starts after this index
+            form["waitIndex"] = str(after_index + 1)
+        if recursive:
+            form["recursive"] = "true"
+        status, body, headers = self.api.keys("GET", key, form)
+        if "errorCode" in body:
+            raise Error.from_json(body)
+        return Watcher(self.api, body.get("event"), body.get("watch_id"),
+                       headers)
+
+
+class MembersAPI:
+    """client/v2 MembersAPI over the gateway."""
+
+    def __init__(self, api: V2Api):
+        self.api = api
+
+    def list(self) -> list[dict]:
+        status, body, _ = self.api.members("GET")
+        return body["members"]
+
+    def add(self, member_id: int, learner: bool = False) -> dict:
+        status, body, _ = self.api.members(
+            "POST", form={"id": member_id, "isLearner": learner})
+        if status >= 400:
+            raise Error(0, body.get("message", ""), "", 0)
+        return body
+
+    def remove(self, member_id: int) -> None:
+        status, body, _ = self.api.members("DELETE", suffix=str(member_id))
+        if status >= 400:
+            raise Error(0, body.get("message", ""), "", 0)
+
+
+class HttpV2Api:
+    """Wire transport: the same (method, key, form) -> (status, body,
+    headers) surface as V2Api, over real HTTP against a gateway — the
+    client/v2 httpClient path (client.go) collapsed to urllib."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _do(self, method: str, path: str,
+            form: dict | None) -> tuple[int, dict, dict]:
+        import json
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        url = self.base_url + path
+        data = None
+        if form and method == "GET":
+            url += "?" + urllib.parse.urlencode(form)
+        elif form:
+            data = urllib.parse.urlencode(form).encode()
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Content-Type":
+                     "application/x-www-form-urlencoded"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body, hdrs = json.loads(r.read() or b"{}"), r.headers
+                status = r.status
+        except urllib.error.HTTPError as e:
+            body, hdrs, status = json.loads(e.read() or b"{}"), \
+                e.headers, e.code
+        headers = {"X-Etcd-Index": int(hdrs.get("X-Etcd-Index", 0) or 0)}
+        return status, body, headers
+
+    def keys(self, method: str, key: str,
+             form: dict | None = None) -> tuple[int, dict, dict]:
+        return self._do(method, "/v2/keys" + key, form)
+
+    def watch_poll(self, watch_id: int) -> tuple[int, dict, dict]:
+        return self._do("GET", f"/v2/watch_poll/{watch_id}", None)
+
+    def watch_cancel(self, watch_id: int) -> None:
+        self._do("DELETE", f"/v2/watch_poll/{watch_id}", None)
+
+    def members(self, method: str, suffix: str = "",
+                form: dict | None = None) -> tuple[int, dict, dict]:
+        return self._do(method, "/v2/members" +
+                        (f"/{suffix.strip('/')}" if suffix else ""), form)
+
+    def stats(self, which: str) -> tuple[int, dict, dict]:
+        return self._do("GET", f"/v2/stats/{which}", None)
+
+
+class ClientV2:
+    """client/v2 Client: the keys + members handles. Accepts an
+    in-process V2Api, an EtcdCluster (wrapped), or an endpoint URL
+    string (wire transport)."""
+
+    def __init__(self, ec_or_api):
+        if isinstance(ec_or_api, str):
+            api: Any = HttpV2Api(ec_or_api)
+        elif isinstance(ec_or_api, (V2Api, HttpV2Api)):
+            api = ec_or_api
+        else:
+            api = V2Api(ec_or_api)
+        self.api = api
+        self.keys = KeysAPI(api)
+        self.members = MembersAPI(api)
+
+
+def new(ec_or_api) -> ClientV2:
+    """client.New analog."""
+    return ClientV2(ec_or_api)

@@ -1,0 +1,168 @@
+"""Cluster bootstrap via a discovery service — the v2discovery analog.
+
+Re-design of ``server/etcdserver/api/v2discovery/discovery.go``: a new
+cluster's members meet at a shared token directory on an existing etcd
+(any v2-serving cluster in this framework), each registering
+``token/<member-id> = "name=peer-url"`` and waiting until ``size`` (from
+``token/_config/size``) members appear, then deriving the identical
+initial-cluster string from the first ``size`` registrations sorted by
+creation index (discovery.go:160-412).
+
+Blocking waits become poll loops over the clientv2 watcher (this
+framework's long-poll convention); a ``wait_hook`` lets a driver
+interleave the other members' registrations, standing in for the
+concurrent processes of the reference world.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from etcd_tpu import clientv2
+from etcd_tpu.clientv2 import KeysAPI
+from etcd_tpu.server.v2store import EcodeKeyNotFound, EcodeNodeExist
+
+
+class DiscoveryError(Exception):
+    pass
+
+
+class ErrSizeNotFound(DiscoveryError):
+    """discovery: size key not found"""
+
+
+class ErrBadSizeKey(DiscoveryError):
+    """discovery: size key is bad"""
+
+
+class ErrDuplicateID(DiscoveryError):
+    """discovery: found duplicate id"""
+
+
+class ErrDuplicateName(DiscoveryError):
+    """discovery: found duplicate name"""
+
+
+class ErrFullCluster(DiscoveryError):
+    """discovery: cluster is full"""
+
+
+class ErrTooManyRetries(DiscoveryError):
+    """discovery: too many retries"""
+
+
+def create_token(keys: KeysAPI, token: str, size: int) -> None:
+    """Seed a discovery token the way the public discovery.etcd.io
+    /new endpoint does: write token/_config/size."""
+    keys.set(f"/{token}/_config/size", str(size))
+
+
+class Discovery:
+    """One member's discovery session (discovery.go discovery struct)."""
+
+    MAX_WAIT_POLLS = 256  # nRetries stand-in for the poll loop
+
+    def __init__(self, keys: KeysAPI, token: str, member_id: int | str,
+                 wait_hook: Callable[[], None] | None = None):
+        self.c = keys
+        self.cluster = token.strip("/")
+        self.id = str(member_id)
+        # called between empty watch polls — the test-world stand-in for
+        # other member processes making progress concurrently
+        self.wait_hook = wait_hook
+
+    # -- public (discovery.go:60-90)
+    def join_cluster(self, config: str) -> str:
+        """JoinCluster: register self, wait for size peers, derive the
+        initial-cluster string. `config` is "name=peer-url"."""
+        self._check_cluster()  # fast-path full/size errors pre-register
+        self._create_self(config)
+        nodes, size, index = self._check_cluster()
+        all_nodes = self._wait_nodes(nodes, size, index)
+        return nodes_to_cluster(all_nodes, size)
+
+    def get_cluster(self) -> str:
+        """GetCluster: observer path — no registration."""
+        try:
+            nodes, size, index = self._check_cluster()
+        except ErrFullCluster as e:
+            return nodes_to_cluster(e.args[0], e.args[1])
+        all_nodes = self._wait_nodes(nodes, size, index)
+        return nodes_to_cluster(all_nodes, size)
+
+    # -- internals
+    def _self_key(self) -> str:
+        return f"/{self.cluster}/{self.id}"
+
+    def _create_self(self, contents: str) -> None:
+        # discovery.go:203-218: Create fails NodeExist -> duplicate id
+        try:
+            self.c.create(self._self_key(), contents)
+        except clientv2.Error as e:
+            if e.code == EcodeNodeExist:
+                raise ErrDuplicateID() from None
+            raise
+
+    def _check_cluster(self):
+        # discovery.go:220-287
+        try:
+            resp = self.c.get(f"/{self.cluster}/_config/size")
+        except clientv2.Error as e:
+            if e.code == EcodeKeyNotFound:
+                raise ErrSizeNotFound() from None
+            raise
+        try:
+            size = int(resp.node["value"])
+            if size <= 0:
+                raise ValueError
+        except (ValueError, TypeError):
+            raise ErrBadSizeKey() from None
+
+        resp = self.c.get(f"/{self.cluster}")
+        nodes = [n for n in resp.node.get("nodes", [])
+                 if not n["key"].rsplit("/", 1)[-1].startswith("_")]
+        nodes.sort(key=lambda n: n["createdIndex"])
+        # find self among the first `size` registrants
+        for i, n in enumerate(nodes):
+            if n["key"].rsplit("/", 1)[-1] == self.id:
+                break
+            if i >= size - 1:
+                raise ErrFullCluster(nodes[:size], size)
+        return nodes, size, resp.index
+
+    def _wait_nodes(self, nodes: list, size: int, index: int) -> list:
+        # discovery.go:326-383: watch the token dir until size appear
+        if len(nodes) > size:
+            nodes = nodes[:size]
+        all_nodes = list(nodes)
+        w = self.c.watcher(f"/{self.cluster}", after_index=index,
+                           recursive=True)
+        polls = 0
+        while len(all_nodes) < size:
+            ev = w.next()
+            if ev is None:
+                polls += 1
+                if polls > self.MAX_WAIT_POLLS:
+                    raise ErrTooManyRetries()
+                if self.wait_hook is not None:
+                    self.wait_hook()
+                continue
+            name = ev.node["key"].rsplit("/", 1)[-1]
+            if name.startswith("_"):
+                continue
+            all_nodes.append(ev.node)
+        return all_nodes
+
+
+def nodes_to_cluster(nodes: list, size: int) -> str:
+    """discovery.go:390-406: join registrations into the initial-cluster
+    string; names must be unique."""
+    us = ",".join(n["value"] for n in nodes)
+    names = set()
+    for part in us.split(","):
+        name = part.split("=", 1)[0]
+        if name in names:
+            raise ErrDuplicateName(us)
+        names.add(name)
+    if len(us.split(",")) != size:
+        raise ErrDuplicateName(us)
+    return us

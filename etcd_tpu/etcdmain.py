@@ -34,7 +34,82 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--auto-compaction-retention", type=int, default=0)
     p.add_argument("--pre-vote", action=argparse.BooleanOptionalAction,
                    default=True)
+    # cluster bootstrap via a discovery service (etcdmain --discovery):
+    # "<gateway-url>/<token>"; cluster size comes from the token's
+    # _config/size record (v2discovery)
+    p.add_argument("--discovery", default=None)
+    # v2 proxy mode (startEtcdOrProxyV2's startProxy branch): serve a
+    # failover reverse proxy over the listed endpoints instead of a
+    # cluster
+    p.add_argument("--proxy", choices=["off", "on"], default="off")
+    p.add_argument("--proxy-endpoints", default="",
+                   help="comma list of gateway URLs to proxy")
+    p.add_argument("--proxy-failure-wait", type=float, default=5.0)
+    p.add_argument("--proxy-refresh-interval", type=float, default=30.0)
     return p
+
+
+def run_proxy(args) -> int:
+    """httpproxy mode: forward every request to the first available
+    endpoint (proxy/httpproxy NewHandler + etcdmain startProxy)."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from etcd_tpu.httpproxy import Director, HTTPProxy, urllib_transport
+
+    urls = [u for u in args.proxy_endpoints.split(",") if u]
+    if args.discovery and not urls:
+        base, token = args.discovery.rsplit("/", 1)
+        from etcd_tpu import clientv2, discovery
+
+        keys = clientv2.new(base).keys
+        cluster = discovery.Discovery(keys, token, "proxy").get_cluster()
+        urls = [part.split("=", 1)[1] for part in cluster.split(",")]
+    d = Director(lambda: urls, args.proxy_failure_wait,
+                 args.proxy_refresh_interval)
+    proxy = HTTPProxy(d, urllib_transport)
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _handle(self):
+            from urllib.parse import parse_qsl, urlsplit
+
+            form = dict(parse_qsl(urlsplit(self.path).query,
+                                  keep_blank_values=True))
+            n = int(self.headers.get("Content-Length", "0") or 0)
+            if n:
+                form.update(parse_qsl(self.rfile.read(n).decode(),
+                                      keep_blank_values=True))
+            st, body, hdr = proxy.handle(
+                self.command, urlsplit(self.path).path, form)
+            blob = json.dumps(body).encode()
+            self.send_response(st)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            for k, v in hdr.items():
+                if k.lower().startswith("x-etcd"):
+                    self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        do_GET = do_PUT = do_POST = do_DELETE = _handle
+
+    httpd = ThreadingHTTPServer(
+        (args.listen_client_host, args.listen_client_port), Handler)
+    print(f"etcd-tpu proxy serving "
+          f"http://{args.listen_client_host}:"
+          f"{httpd.server_address[1]} -> {urls}", file=sys.stderr)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
 
 
 def main(argv=None) -> int:
@@ -57,12 +132,30 @@ def main(argv=None) -> int:
     from etcd_tpu.embed import Config, start_etcd
 
     args = build_parser().parse_args(argv)
+    if args.proxy == "on":
+        return run_proxy(args)
+    cluster_size = args.cluster_size
+    if args.discovery:
+        # join the discovery token before boot (etcd.go startEtcd's
+        # discovery branch): the token's size record decides the
+        # cluster size every joiner agrees on
+        from etcd_tpu import clientv2, discovery
+
+        base, token = args.discovery.rsplit("/", 1)
+        keys = clientv2.new(base).keys
+        d = discovery.Discovery(keys, token, args.name)
+        cluster_str = d.join_cluster(
+            f"{args.name}=http://{args.listen_client_host}:"
+            f"{args.listen_client_port}")
+        cluster_size = len(cluster_str.split(","))
+        print(f"discovery: joined cluster [{cluster_str}]",
+              file=sys.stderr)
     cfg = Config(
         name=args.name,
         data_dir=args.data_dir,
         listen_client_host=args.listen_client_host,
         listen_client_port=args.listen_client_port,
-        cluster_size=args.cluster_size,
+        cluster_size=cluster_size,
         tick_ms=args.tick_ms,
         election_ticks=max(args.election_timeout // max(args.tick_ms, 1), 2),
         quota_backend_bytes=args.quota_backend_bytes,

@@ -1,0 +1,143 @@
+"""Mixed read/write benchmark sweep — the tools/rw-heatmaps analog.
+
+Re-design of ``tools/rw-heatmaps/rw-benchmark.sh`` + ``plot_data.py``:
+sweep read/write ratio x value size x client concurrency over a live
+cluster, record read & write throughput per cell in the same CSV shape
+the reference's plotter consumes (``type,ratio,conn_size,value_size,
+iterN`` with ``read:write`` cells, plus a PARAM comment row), and
+render the heatmap grids as text (the zero-dependency stand-in for the
+matplotlib images; the CSV remains loadable by the reference's
+plot_data.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Sequence
+
+# scaled-down defaults of rw-benchmark.sh's sweep axes
+DEFAULT_RATIOS = (0.125, 0.5, 2.0, 8.0)   # reads per write
+DEFAULT_VALUE_SIZES = (256, 1024)
+DEFAULT_CONN_COUNTS = (4, 16)
+
+
+def run_cell(ec, ratio: float, conn: int, value_size: int,
+             ops: int) -> tuple[float, float]:
+    """One sweep cell: `ops` operations split reads/writes by `ratio`
+    across `conn` round-robin sessions. Returns (reads/s, writes/s)."""
+    val = b"v" * value_size
+    keys = [b"heat/%d" % i for i in range(conn)]
+    for k in keys:
+        ec.put(k, val)
+    reads = writes = 0
+    r_acc = ratio / (1.0 + ratio)  # fraction of ops that are reads
+    acc = 0.0
+    t0 = time.perf_counter()
+    for i in range(ops):
+        k = keys[i % conn]
+        acc += r_acc
+        if acc >= 1.0:
+            acc -= 1.0
+            ec.range(k)
+            reads += 1
+        else:
+            ec.put(k, val)
+            writes += 1
+    dt = time.perf_counter() - t0 or 1e-9
+    return reads / dt, writes / dt
+
+
+def run_sweep(ec, ratios: Sequence[float] = DEFAULT_RATIOS,
+              value_sizes: Sequence[int] = DEFAULT_VALUE_SIZES,
+              conn_counts: Sequence[int] = DEFAULT_CONN_COUNTS,
+              repeats: int = 1, ops: int = 64) -> list[dict]:
+    rows = []
+    for ratio in ratios:
+        for conn in conn_counts:
+            for vs in value_sizes:
+                iters = [run_cell(ec, ratio, conn, vs, ops)
+                         for _ in range(repeats)]
+                rows.append({"type": "DATA", "ratio": ratio,
+                             "conn_size": conn, "value_size": vs,
+                             "iters": iters})
+    return rows
+
+
+def write_csv(rows: list[dict], path: str, comment: str = "") -> None:
+    """rw-benchmark.sh CSV shape: iterN cells are 'read:write'."""
+    repeats = max((len(r["iters"]) for r in rows), default=1)
+    hdr = ["type", "ratio", "conn_size", "value_size"] + \
+        [f"iter{i}" for i in range(repeats)] + ["comment"]
+    lines = [",".join(hdr)]
+    if comment:
+        lines.append(",".join(
+            ["PARAM", "0", "0", "0"] + [""] * repeats + [comment]))
+    for r in rows:
+        cells = [f"{rd:.1f}:{wr:.1f}" for rd, wr in r["iters"]]
+        cells += [""] * (repeats - len(cells))
+        lines.append(",".join(
+            ["DATA", str(r["ratio"]), str(r["conn_size"]),
+             str(r["value_size"])] + cells + [""]))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def render_ascii(rows: list[dict], metric: str = "read") -> str:
+    """One text heatmap grid per value size: ratio rows x conn cols."""
+    idx = 0 if metric == "read" else 1
+    out = []
+    for vs in sorted({r["value_size"] for r in rows}):
+        sub = [r for r in rows if r["value_size"] == vs]
+        conns = sorted({r["conn_size"] for r in sub})
+        ratios = sorted({r["ratio"] for r in sub})
+        out.append(f"== {metric}/s @ value_size={vs} ==")
+        out.append("ratio\\conn " + " ".join(f"{c:>10}" for c in conns))
+        for ratio in ratios:
+            cells = []
+            for c in conns:
+                rs = [r for r in sub
+                      if r["conn_size"] == c and r["ratio"] == ratio]
+                best = max((it[idx] for r in rs for it in r["iters"]),
+                           default=0.0)
+                cells.append(f"{best:>10.0f}")
+            out.append(f"{ratio:>10} " + " ".join(cells))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="rw-heatmaps")
+    p.add_argument("--output", default="rw_result.csv")
+    p.add_argument("--ops", type=int, default=64)
+    p.add_argument("--repeats", type=int, default=1)
+    p.add_argument("--members", type=int, default=3)
+    p.add_argument("--comment", default="etcd_tpu rw sweep")
+    p.add_argument("--ratios", default=None,
+                   help="comma list of read/write ratios")
+    p.add_argument("--value-sizes", default=None)
+    p.add_argument("--conns", default=None)
+    args = p.parse_args(argv)
+
+    from etcd_tpu.server.kvserver import EtcdCluster
+
+    ec = EtcdCluster(n_members=args.members)
+    ec.ensure_leader()
+    rows = run_sweep(
+        ec,
+        ratios=tuple(float(x) for x in args.ratios.split(","))
+        if args.ratios else DEFAULT_RATIOS,
+        value_sizes=tuple(int(x) for x in args.value_sizes.split(","))
+        if args.value_sizes else DEFAULT_VALUE_SIZES,
+        conn_counts=tuple(int(x) for x in args.conns.split(","))
+        if args.conns else DEFAULT_CONN_COUNTS,
+        repeats=args.repeats, ops=args.ops)
+    write_csv(rows, args.output, comment=args.comment)
+    print(render_ascii(rows, "read"))
+    print(render_ascii(rows, "write"))
+    print(json.dumps({"cells": len(rows), "csv": args.output}))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

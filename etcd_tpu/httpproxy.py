@@ -1,0 +1,125 @@
+"""The v2 HTTP proxy — director + reverse-forwarder analog.
+
+Re-design of ``server/proxy/httpproxy`` (director.go, reverse.go,
+proxy.go): a director keeps the endpoint set fresh from a URL source
+(static list or discovery ``get_cluster``), marks endpoints unavailable
+for ``failure_wait`` seconds when a forward fails, and the proxy tries
+available endpoints in order — 503 with the reference's message when
+none remain (reverse.go:100-107).
+
+Transport is pluggable: ``transport(url, method, path, form)`` returns
+``(status, body, headers)`` — in-process fakes in tests, a urllib
+round-trip against gateway servers in deployment.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Callable
+
+DEFAULT_REFRESH_INTERVAL = 30.0  # director.go:28 (30000ms)
+DEFAULT_FAILURE_WAIT = 5.0       # etcdmain proxy-failure-wait default
+
+
+class Endpoint:
+    """director.go endpoint: URL + availability latch."""
+
+    def __init__(self, url: str, clock: Callable[[], float]):
+        self.url = url
+        self.available = True
+        self._clock = clock
+        self._failed_at = 0.0
+
+    def failed(self, wait: float) -> None:
+        self.available = False
+        self._failed_at = self._clock()
+        self._wait = wait
+
+    def maybe_recover(self) -> None:
+        # the deferred goroutine of director.go endpoint.Failed: the
+        # endpoint returns to rotation after failureWait
+        if not self.available and \
+                self._clock() - self._failed_at >= self._wait:
+            self.available = True
+
+
+class Director:
+    """director.go director: refresh endpoints from urls_fn."""
+
+    def __init__(self, urls_fn: Callable[[], list[str]],
+                 failure_wait: float = DEFAULT_FAILURE_WAIT,
+                 refresh_interval: float = DEFAULT_REFRESH_INTERVAL,
+                 clock: Callable[[], float] | None = None):
+        self.urls_fn = urls_fn
+        self.failure_wait = failure_wait
+        self.refresh_interval = refresh_interval
+        self.clock = clock or _time.time
+        self._eps: list[Endpoint] = []
+        self._last_refresh = -1e18
+        self.refresh()
+
+    def refresh(self) -> None:
+        self._last_refresh = self.clock()
+        by_url = {e.url: e for e in self._eps}
+        self._eps = [by_url.get(u) or Endpoint(u, self.clock)
+                     for u in self.urls_fn()]
+
+    def _maybe_refresh(self) -> None:
+        if self.clock() - self._last_refresh >= self.refresh_interval:
+            self.refresh()
+
+    def endpoints(self) -> list[Endpoint]:
+        """Available endpoints only (director.go endpoints())."""
+        self._maybe_refresh()
+        for e in self._eps:
+            e.maybe_recover()
+        return [e for e in self._eps if e.available]
+
+
+class HTTPProxy:
+    """reverse.go reverseProxy.ServeHTTP: try endpoints in order,
+    marking failures, 503 when the rotation is empty."""
+
+    def __init__(self, director: Director,
+                 transport: Callable[[str, str, str, dict],
+                                     tuple[int, dict, dict]]):
+        self.director = director
+        self.transport = transport
+
+    def handle(self, method: str, path: str,
+               form: dict | None = None) -> tuple[int, dict, dict]:
+        eps = self.director.endpoints()
+        if not eps:
+            return 503, {"message":
+                         "httpproxy: zero endpoints currently available"
+                         }, {}
+        for ep in eps:
+            try:
+                return self.transport(ep.url, method, path, form or {})
+            except Exception:
+                # reverse.go:139-151: transport error -> mark endpoint
+                # unavailable and try the next one
+                ep.failed(self.director.failure_wait)
+        return 503, {"message":
+                     "httpproxy: unable to get response from "
+                     f"{len(eps)} endpoint(s)"}, {}
+
+
+def urllib_transport(url: str, method: str, path: str,
+                     form: dict) -> tuple[int, dict, dict]:
+    """Deployment transport: forward over real HTTP to a gateway."""
+    import json
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    data = urllib.parse.urlencode(form).encode() if form else None
+    req = urllib.request.Request(
+        url + path, data=data, method=method,
+        headers={"Content-Type": "application/x-www-form-urlencoded"})
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        # HTTP-level errors are valid proxy responses, not endpoint
+        # failures (reverse.go forwards them through)
+        return e.code, json.loads(e.read()), dict(e.headers)

@@ -634,7 +634,12 @@ class EtcdCluster:
             "installing peer snapshot on member %d from donor %d at "
             "index %d", m, donor, self.members[donor].applied_index,
         )
-        self.restore_member(m, self.member_snapshot(donor))
+        # the snapshot moves through the streamed side-channel (chunked,
+        # per-chunk + total CRC — snapshot_sender.go / snap/db.go), so a
+        # torn or corrupted transfer raises instead of installing
+        from etcd_tpu.storage.snapstream import transfer
+
+        self.restore_member(m, transfer(self.member_snapshot(donor)))
         failpoints.fire("raftAfterApplySnap")
 
     # -- state-machine snapshots (full applied state, not just KV) ----------

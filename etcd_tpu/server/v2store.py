@@ -301,6 +301,27 @@ class Node:
             return False, 2  # CompareValueNotMatch
         return False, 3  # CompareNotMatch
 
+    def extern(self, recursive: bool, sorted_: bool, now: float) -> dict:
+        """loadInternalNode (node_extern.go:38-70): the GET top-level
+        repr — a dir ALWAYS lists its direct children (hidden skipped);
+        `recursive` only controls whether those children recurse."""
+        if not self.is_dir():
+            return self.repr(False, False, now)
+        out: dict[str, Any] = {
+            "key": self.path, "dir": True,
+            "modifiedIndex": self.modified_index,
+            "createdIndex": self.created_index,
+        }
+        exp, ttl = self.expiration_and_ttl(now)
+        if exp is not None:
+            out["expiration"], out["ttl"] = exp, ttl
+        nodes = [c.repr(recursive, sorted_, now)
+                 for c in self.children.values() if not c.is_hidden()]
+        if sorted_:
+            nodes.sort(key=lambda n: n["key"])
+        out["nodes"] = nodes
+        return out
+
     # ---- repr (node.go:258-310)
     def repr(self, recursive: bool, sorted_: bool, now: float) -> dict:
         if self.is_dir():
@@ -629,9 +650,8 @@ class V2Store:
             self.stats.inc("getsFail")
             raise
         now = self.clock()
-        e = Event(GET, n.repr(recursive, sorted_, now),
+        e = Event(GET, n.extern(recursive, sorted_, now),
                   etcd_index=self.current_index)
-        # top-level repr carries created/modified of the node itself
         self.stats.inc("getsSuccess")
         return e
 

@@ -516,8 +516,12 @@ class V3Server:
 
     def __init__(self, ec: EtcdCluster, host: str = "127.0.0.1",
                  port: int = 0):
+        from etcd_tpu.server.v2http import KEYS_PREFIX, V2Api
+
         self.api = V3Api(ec)
         api = self.api
+        self.v2api = V2Api(ec)
+        v2api = self.v2api
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -525,15 +529,83 @@ class V3Server:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, code: int, obj: dict) -> None:
+            def _send(self, code: int, obj: dict,
+                      headers: dict | None = None) -> None:
                 blob = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(blob)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(blob)
 
+            # ---- v2 REST family (api/v2http client.go handler mux)
+            def _v2_form(self) -> dict:
+                from urllib.parse import parse_qsl, urlsplit
+
+                form = dict(parse_qsl(urlsplit(self.path).query,
+                                      keep_blank_values=True))
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                if n:
+                    body = self.rfile.read(n).decode()
+                    ctype = self.headers.get("Content-Type", "")
+                    if "json" in ctype:
+                        try:
+                            form.update(json.loads(body or "{}"))
+                        except json.JSONDecodeError:
+                            pass
+                    else:
+                        form.update(parse_qsl(body,
+                                              keep_blank_values=True))
+                return form
+
+            def _maybe_v2(self) -> bool:
+                from urllib.parse import urlsplit
+
+                path = urlsplit(self.path).path
+                if path.startswith(KEYS_PREFIX):
+                    key = path[len(KEYS_PREFIX):] or "/"
+                    with api.lock:
+                        st, body, hdr = v2api.keys(
+                            self.command, key, self._v2_form())
+                    self._send(st, body, hdr)
+                    return True
+                if path.startswith("/v2/watch_poll/"):
+                    wid = int(path.rsplit("/", 1)[1])
+                    with api.lock:
+                        if self.command == "DELETE":
+                            v2api.watch_cancel(wid)
+                            st, body, hdr = 204, {}, {}
+                        else:
+                            st, body, hdr = v2api.watch_poll(wid)
+                    self._send(st, body, hdr)
+                    return True
+                if path.startswith("/v2/members"):
+                    suffix = path[len("/v2/members"):]
+                    with api.lock:
+                        st, body, hdr = v2api.members(
+                            self.command, suffix, self._v2_form())
+                    self._send(st, body, hdr)
+                    return True
+                if path.startswith("/v2/stats/"):
+                    with api.lock:
+                        st, body, hdr = v2api.stats(path.rsplit("/", 1)[1])
+                    self._send(st, body, hdr)
+                    return True
+                return False
+
+            def do_PUT(self):
+                if not self._maybe_v2():
+                    self._send(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                if not self._maybe_v2():
+                    self._send(404, {"error": "not found"})
+
             def do_GET(self):
+                if self._maybe_v2():
+                    return
                 # etcdhttp: /health, /version, /metrics (api/etcdhttp)
                 if self.path == "/health":
                     with api.lock:
@@ -582,6 +654,8 @@ class V3Server:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):
+                if self._maybe_v2():
+                    return
                 n = int(self.headers.get("Content-Length", "0"))
                 try:
                     q = json.loads(self.rfile.read(n) or b"{}")

@@ -1,0 +1,75 @@
+"""rw-heatmaps analog: mixed read/write sweep + CSV in the reference
+plotter's schema + text heatmap rendering (tools/rw-heatmaps)."""
+import pytest
+
+from etcd_tpu import heatmaps
+from etcd_tpu.server.kvserver import EtcdCluster
+
+
+@pytest.fixture(scope="module")
+def ec():
+    c = EtcdCluster(n_members=3)
+    c.ensure_leader()
+    return c
+
+
+@pytest.fixture(scope="module")
+def rows(ec):
+    return heatmaps.run_sweep(
+        ec, ratios=(0.5, 2.0), value_sizes=(64,), conn_counts=(2,),
+        repeats=2, ops=8)
+
+
+def test_sweep_shape(rows):
+    assert len(rows) == 2  # 2 ratios x 1 conn x 1 value size
+    for r in rows:
+        assert len(r["iters"]) == 2
+        for rd, wr in r["iters"]:
+            assert rd >= 0 and wr > 0
+
+
+def test_ratio_controls_mix(ec):
+    """ratio=8 must do ~8x more reads than writes; ratio=1/8 inverted."""
+    rows = heatmaps.run_sweep(ec, ratios=(8.0,), value_sizes=(64,),
+                              conn_counts=(2,), ops=18)
+    rd, wr = rows[0]["iters"][0]
+    assert rd > wr * 4
+    rows = heatmaps.run_sweep(ec, ratios=(0.125,), value_sizes=(64,),
+                              conn_counts=(2,), ops=18)
+    rd, wr = rows[0]["iters"][0]
+    assert wr > rd * 4
+
+
+def test_csv_schema(rows, tmp_path):
+    path = str(tmp_path / "rw.csv")
+    heatmaps.write_csv(rows, path, comment="test sweep")
+    lines = open(path).read().strip().split("\n")
+    hdr = lines[0].split(",")
+    assert hdr[:4] == ["type", "ratio", "conn_size", "value_size"]
+    assert "iter0" in hdr and "iter1" in hdr and hdr[-1] == "comment"
+    assert lines[1].startswith("PARAM")
+    assert "test sweep" in lines[1]
+    data = [ln for ln in lines if ln.startswith("DATA")]
+    assert len(data) == len(rows)
+    # iter cells are read:write pairs, the plot_data.py contract
+    cell = data[0].split(",")[4]
+    rd, wr = cell.split(":")
+    float(rd), float(wr)
+
+
+def test_render_ascii(rows):
+    txt = heatmaps.render_ascii(rows, "read")
+    assert "value_size=64" in txt
+    assert "ratio\\conn" in txt
+    txt_w = heatmaps.render_ascii(rows, "write")
+    assert txt != txt_w
+
+
+def test_cli(tmp_path, capsys, monkeypatch):
+    out = str(tmp_path / "cli.csv")
+    rc = heatmaps.main(["--output", out, "--ops", "6", "--members", "3",
+                        "--ratios", "2", "--value-sizes", "64",
+                        "--conns", "2"])
+    assert rc == 0
+    assert open(out).readline().startswith("type,")
+    assert "cells" in capsys.readouterr().out

@@ -1,0 +1,183 @@
+"""v2discovery bootstrap flow + httpproxy director/failover
+(v2discovery/discovery.go, proxy/httpproxy/{director,reverse}.go)."""
+import pytest
+
+from etcd_tpu import clientv2, discovery
+from etcd_tpu.httpproxy import Director, HTTPProxy
+from etcd_tpu.server.kvserver import EtcdCluster
+from etcd_tpu.server.v2http import V2Api
+
+
+@pytest.fixture(scope="module")
+def disco_keys():
+    """The discovery service: any v2-serving cluster."""
+    ec = EtcdCluster(n_members=3)
+    ec.ensure_leader()
+    return clientv2.new(V2Api(ec)).keys
+
+
+def fresh_token(keys, name, size):
+    discovery.create_token(keys, name, size)
+    return name
+
+
+# ---------------------------------------------------------- discovery
+
+def test_join_cluster_three_members(disco_keys):
+    keys = disco_keys
+    tok = fresh_token(keys, "tok3", 3)
+    regs = [(10, "m0=http://h0:2380"), (11, "m1=http://h1:2380"),
+            (12, "m2=http://h2:2380")]
+
+    pending = list(regs[1:])
+
+    def register_next():
+        if pending:
+            mid, cfg = pending.pop(0)
+            discovery.Discovery(keys, tok, mid)._create_self(cfg)
+
+    d0 = discovery.Discovery(keys, tok, regs[0][0],
+                             wait_hook=register_next)
+    cluster = d0.join_cluster(regs[0][1])
+    assert cluster == "m0=http://h0:2380,m1=http://h1:2380,m2=http://h2:2380"
+    # a later joiner sees the already-complete set without waiting
+    d2 = discovery.Discovery(keys, tok, regs[2][0])
+    assert d2.get_cluster() == cluster
+
+
+def test_join_duplicate_id(disco_keys):
+    keys = disco_keys
+    tok = fresh_token(keys, "tokdup", 2)
+    d = discovery.Discovery(keys, tok, 7)
+    d._create_self("a=http://a:2380")
+    with pytest.raises(discovery.ErrDuplicateID):
+        discovery.Discovery(keys, tok, 7).join_cluster("a=http://a:2380")
+
+
+def test_join_full_cluster(disco_keys):
+    keys = disco_keys
+    tok = fresh_token(keys, "tokfull", 1)
+    discovery.Discovery(keys, tok, 1).join_cluster("a=http://a:2380")
+    with pytest.raises(discovery.ErrFullCluster):
+        discovery.Discovery(keys, tok, 2).join_cluster("b=http://b:2380")
+    # observers can still read the full cluster
+    assert discovery.Discovery(keys, tok, 99).get_cluster() == \
+        "a=http://a:2380"
+
+
+def test_size_key_missing_and_bad(disco_keys):
+    keys = disco_keys
+    with pytest.raises(discovery.ErrSizeNotFound):
+        discovery.Discovery(keys, "tok404", 1).join_cluster("a=u")
+    discovery.create_token(keys, "tokbad", 0)
+    keys.set("/tokbad/_config/size", "zero")
+    with pytest.raises(discovery.ErrBadSizeKey):
+        discovery.Discovery(keys, "tokbad", 1).join_cluster("a=u")
+
+
+def test_duplicate_name_rejected(disco_keys):
+    keys = disco_keys
+    tok = fresh_token(keys, "tokname", 2)
+    discovery.Discovery(keys, tok, 1)._create_self("same=http://a:2380")
+    discovery.Discovery(keys, tok, 2)._create_self("same=http://b:2380")
+    with pytest.raises(discovery.ErrDuplicateName):
+        discovery.Discovery(keys, tok, 1).get_cluster()
+
+
+def test_wait_times_out_without_peers(disco_keys):
+    keys = disco_keys
+    tok = fresh_token(keys, "tokwait", 3)
+    d = discovery.Discovery(keys, tok, 5)
+    d.MAX_WAIT_POLLS = 3
+    with pytest.raises(discovery.ErrTooManyRetries):
+        d.join_cluster("only=http://x:2380")
+
+
+# ---------------------------------------------------------- httpproxy
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def ok_transport(tag):
+    def t(url, method, path, form):
+        return 200, {"served_by": url, "tag": tag}, {}
+    return t
+
+
+def test_proxy_forwards_to_first_available():
+    clk = FakeClock()
+    d = Director(lambda: ["http://a", "http://b"], clock=clk)
+    p = HTTPProxy(d, ok_transport("x"))
+    st, body, _ = p.handle("GET", "/v2/keys/k")
+    assert st == 200 and body["served_by"] == "http://a"
+
+
+def test_proxy_failover_and_recovery():
+    clk = FakeClock()
+    d = Director(lambda: ["http://bad", "http://good"],
+                 failure_wait=5.0, clock=clk)
+    calls = []
+
+    def transport(url, method, path, form):
+        calls.append(url)
+        if url == "http://bad":
+            raise ConnectionError("refused")
+        return 200, {"served_by": url}, {}
+
+    p = HTTPProxy(d, transport)
+    st, body, _ = p.handle("GET", "/")
+    assert body["served_by"] == "http://good"
+    # bad endpoint now out of rotation
+    calls.clear()
+    p.handle("GET", "/")
+    assert calls == ["http://good"]
+    # after failure_wait it returns
+    clk.t += 6
+    calls.clear()
+    p.handle("GET", "/")
+    assert calls[0] == "http://bad"
+
+
+def test_proxy_zero_endpoints_503():
+    d = Director(lambda: [], clock=FakeClock())
+    p = HTTPProxy(d, ok_transport("x"))
+    st, body, _ = p.handle("GET", "/")
+    assert st == 503
+    assert "zero endpoints" in body["message"]
+
+
+def test_proxy_all_endpoints_down_503():
+    clk = FakeClock()
+    d = Director(lambda: ["http://a"], clock=clk)
+
+    def transport(url, *a):
+        raise ConnectionError()
+
+    p = HTTPProxy(d, transport)
+    st, body, _ = p.handle("GET", "/")
+    assert st == 503
+
+
+def test_director_refresh_picks_up_new_urls():
+    clk = FakeClock()
+    urls = ["http://a"]
+    d = Director(lambda: list(urls), refresh_interval=30.0, clock=clk)
+    assert [e.url for e in d.endpoints()] == ["http://a"]
+    urls.append("http://b")
+    assert [e.url for e in d.endpoints()] == ["http://a"]  # not yet
+    clk.t += 31
+    assert [e.url for e in d.endpoints()] == ["http://a", "http://b"]
+
+
+def test_director_keeps_endpoint_state_across_refresh():
+    clk = FakeClock()
+    d = Director(lambda: ["http://a", "http://b"],
+                 failure_wait=100.0, refresh_interval=1.0, clock=clk)
+    d.endpoints()[0].failed(100.0)
+    clk.t += 2  # refresh happens, but 'a' stays marked failed
+    assert [e.url for e in d.endpoints()] == ["http://b"]

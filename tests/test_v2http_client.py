@@ -1,0 +1,282 @@
+"""v2 REST façade + clientv2 — parseKeyRequest validation ladder
+(v2http/client.go:346-527), HTTP status mapping (v2error/error.go:71-80),
+the client/v2 KeysAPI/MembersAPI surface, and one over-the-wire pass
+through the embedded gateway."""
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from etcd_tpu import clientv2
+from etcd_tpu.embed import Config, start_etcd
+from etcd_tpu.server.kvserver import EtcdCluster
+from etcd_tpu.server.v2http import V2Api
+from etcd_tpu.server.v2store import (
+    EcodeIndexNaN,
+    EcodeInvalidField,
+    EcodeKeyNotFound,
+    EcodeNodeExist,
+    EcodePrevValueRequired,
+    EcodeRefreshTTLRequired,
+    EcodeRefreshValue,
+    EcodeTestFailed,
+    EcodeTTLNaN,
+)
+
+
+@pytest.fixture(scope="module")
+def ec():
+    c = EtcdCluster(n_members=3)
+    c.ensure_leader()
+    return c
+
+
+@pytest.fixture()
+def api(ec):
+    return V2Api(ec)
+
+
+@pytest.fixture()
+def cli(api):
+    return clientv2.new(api)
+
+
+# ------------------------------------------------ parse validation ladder
+
+@pytest.mark.parametrize("form,code", [
+    ({"prevIndex": "abc"}, EcodeIndexNaN),
+    ({"waitIndex": "x"}, EcodeIndexNaN),
+    ({"recursive": "yes"}, EcodeInvalidField),
+    ({"sorted": "1"}, EcodeInvalidField),
+    ({"prevValue": ""}, EcodePrevValueRequired),
+    ({"ttl": "bad"}, EcodeTTLNaN),
+    ({"prevExist": "maybe"}, EcodeInvalidField),
+    ({"refresh": "true", "value": "v", "ttl": "5"}, EcodeRefreshValue),
+    ({"refresh": "true"}, EcodeRefreshTTLRequired),
+])
+def test_parse_errors(api, form, code):
+    status, body, _ = api.keys("PUT", "/pk", form)
+    assert body["errorCode"] == code
+    assert status == 400
+
+
+def test_wait_only_with_get(api):
+    status, body, _ = api.keys("PUT", "/pk", {"wait": "true"})
+    assert body["errorCode"] == EcodeInvalidField
+
+
+# ------------------------------------------------ status codes
+
+def test_statuses(api):
+    status, body, hdr = api.keys("PUT", "/s1", {"value": "v"})
+    assert status == 201  # created
+    assert body["action"] == "set"
+    assert hdr["X-Etcd-Index"] >= 1
+    status, body, _ = api.keys("PUT", "/s1", {"value": "v2"})
+    assert status == 200  # replaced, not created
+    status, body, _ = api.keys("GET", "/nope", {})
+    assert status == 404
+    assert body["errorCode"] == EcodeKeyNotFound
+    status, body, _ = api.keys(
+        "PUT", "/s1", {"value": "x", "prevValue": "bad"})
+    assert status == 412
+    assert body["errorCode"] == EcodeTestFailed
+    status, body, _ = api.keys(
+        "PUT", "/s1", {"value": "x", "prevExist": "false"})
+    assert status == 412
+    assert body["errorCode"] == EcodeNodeExist
+
+
+def test_no_value_on_success(api):
+    status, body, _ = api.keys(
+        "PUT", "/nv", {"value": "secret", "noValueOnSuccess": "true"})
+    assert "value" not in body["node"]
+
+
+def test_quorum_get(api):
+    api.keys("PUT", "/qg", {"value": "v"})
+    status, body, _ = api.keys("GET", "/qg", {"quorum": "true"})
+    assert body["node"]["value"] == "v"
+
+
+def test_watch_longpoll_registry(api):
+    status, body, _ = api.keys("GET", "/wlp", {"wait": "true"})
+    assert "watch_id" in body
+    wid = body["watch_id"]
+    status, body, _ = api.watch_poll(wid)
+    assert body == {}  # nothing yet
+    api.keys("PUT", "/wlp", {"value": "v"})
+    status, body, _ = api.watch_poll(wid)
+    assert body["event"]["action"] == "set"
+    # one-shot: consumed and deregistered
+    assert api.watch_poll(wid)[0] == 404
+
+
+def test_watch_history_immediate(api):
+    api.keys("PUT", "/wh", {"value": "v"})
+    idx = api._store().current_index
+    status, body, _ = api.keys(
+        "GET", "/wh", {"wait": "true", "waitIndex": str(idx)})
+    assert body["action"] == "set"
+    assert body["node"]["modifiedIndex"] == idx
+
+
+def test_members_and_stats(api):
+    status, body, _ = api.members("GET")
+    assert len(body["members"]) == 3
+    status, body, _ = api.stats("store")
+    assert "setsSuccess" in body
+    assert api.stats("leader")[0] == 200
+    assert api.stats("bogus")[0] == 404
+
+
+# ------------------------------------------------ clientv2 surface
+
+def test_clientv2_set_get_delete(cli):
+    r = cli.keys.set("/c2/a", "v1")
+    assert r.action == "set"
+    r = cli.keys.get("/c2/a")
+    assert r.node["value"] == "v1"
+    r = cli.keys.delete("/c2/a")
+    assert r.action == "delete"
+    with pytest.raises(clientv2.Error) as ei:
+        cli.keys.get("/c2/a")
+    assert ei.value.code == EcodeKeyNotFound
+
+
+def test_clientv2_create_update_cas(cli):
+    r = cli.keys.create("/c2/b", "v1")
+    assert r.action == "create"  # prevExist=false routes to store.Create
+    with pytest.raises(clientv2.Error) as ei:
+        cli.keys.create("/c2/b", "v2")
+    assert ei.value.code == EcodeNodeExist
+    r = cli.keys.update("/c2/b", "v2")
+    assert r.action == "update"
+    r = cli.keys.set("/c2/b", "v3", prev_value="v2")
+    assert r.action == "compareAndSwap"
+    r = cli.keys.delete("/c2/b", prev_value="v3")
+    assert r.action == "compareAndDelete"
+
+
+def test_clientv2_create_in_order(cli):
+    r1 = cli.keys.create_in_order("/c2/q", "a")
+    r2 = cli.keys.create_in_order("/c2/q", "b")
+    assert r1.node["key"] < r2.node["key"]
+    r = cli.keys.get("/c2/q", recursive=True, sort=True)
+    assert [n["value"] for n in r.node["nodes"]] == ["a", "b"]
+
+
+def test_clientv2_watcher(cli):
+    w = cli.keys.watcher("/c2/w", recursive=True)
+    assert w.next() is None
+    cli.keys.set("/c2/w/x", "1")
+    ev = w.next()
+    assert ev is not None and ev.node["key"] == "/c2/w/x"
+    cli.keys.set("/c2/w/y", "2")
+    assert w.next().node["key"] == "/c2/w/y"  # stream watcher persists
+    w.cancel()
+
+
+def test_clientv2_watcher_after_index(cli):
+    cli.keys.set("/c2/ai", "v1")
+    idx = cli.keys.get("/c2/ai").node["modifiedIndex"]
+    cli.keys.set("/c2/ai", "v2")
+    w = cli.keys.watcher("/c2/ai", after_index=idx)
+    ev = w.next()
+    assert ev.node["value"] == "v2"
+
+
+def test_clientv2_members(cli):
+    ms = cli.members.list()
+    assert [m["id"] for m in ms] == ["0", "1", "2"]
+
+
+# ------------------------------------------------ over the wire
+
+@pytest.fixture(scope="module")
+def etcd(tmp_path_factory):
+    cfg = Config(cluster_size=3,
+                 data_dir=str(tmp_path_factory.mktemp("v2embed")),
+                 auto_tick=False)
+    e = start_etcd(cfg)
+    yield e
+    e.close()
+
+
+def _req(etcd, method, path, form=None):
+    data = urllib.parse.urlencode(form or {}).encode() if form else None
+    req = urllib.request.Request(
+        etcd.client_url + path, data=data, method=method,
+        headers={"Content-Type": "application/x-www-form-urlencoded"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def test_http_v2_roundtrip(etcd):
+    st, body, hdr = _req(etcd, "PUT", "/v2/keys/wire/a",
+                         {"value": "v1"})
+    assert st == 201
+    assert body["node"]["key"] == "/wire/a"
+    assert int(hdr["X-Etcd-Index"]) >= 1
+    st, body, _ = _req(etcd, "GET", "/v2/keys/wire/a")
+    assert st == 200 and body["node"]["value"] == "v1"
+    # query-string form on GET
+    st, body, _ = _req(etcd, "GET",
+                       "/v2/keys/wire?recursive=true&sorted=true")
+    assert body["node"]["dir"] is True
+    st, body, _ = _req(etcd, "DELETE", "/v2/keys/wire/a")
+    assert st == 200 and body["action"] == "delete"
+    st, body, _ = _req(etcd, "GET", "/v2/keys/wire/a")
+    assert st == 404 and body["errorCode"] == EcodeKeyNotFound
+
+
+def test_http_v2_members_stats(etcd):
+    st, body, _ = _req(etcd, "GET", "/v2/members")
+    assert st == 200 and len(body["members"]) == 3
+    st, body, _ = _req(etcd, "GET", "/v2/stats/store")
+    assert st == 200 and "setsSuccess" in body
+
+
+def test_http_v2_watch_poll(etcd):
+    st, body, _ = _req(etcd, "GET", "/v2/keys/wp?wait=true")
+    wid = body["watch_id"]
+    _req(etcd, "PUT", "/v2/keys/wp", {"value": "x"})
+    st, body, _ = _req(etcd, "GET", f"/v2/watch_poll/{wid}")
+    assert body["event"]["action"] == "set"
+
+
+def test_clientv2_over_http(etcd):
+    """client/v2 wire path: KeysAPI over HttpV2Api against the gateway."""
+    cli = clientv2.new(etcd.client_url)
+    r = cli.keys.set("/httpc2/a", "v1")
+    assert r.action == "set" and r.index >= 1
+    assert cli.keys.get("/httpc2/a").node["value"] == "v1"
+    w = cli.keys.watcher("/httpc2/b")
+    assert w.next() is None
+    cli.keys.set("/httpc2/b", "x")
+    ev = w.next()
+    assert ev is not None and ev.node["value"] == "x"
+    with pytest.raises(clientv2.Error) as ei:
+        cli.keys.get("/httpc2/nope")
+    assert ei.value.code == EcodeKeyNotFound
+    assert len(cli.members.list()) == 3
+
+
+def test_httpproxy_over_wire(etcd):
+    """httpproxy failover against the live gateway + one dead endpoint."""
+    from etcd_tpu.httpproxy import Director, HTTPProxy, urllib_transport
+
+    d = Director(lambda: ["http://127.0.0.1:1", etcd.client_url],
+                 failure_wait=60.0)
+    p = HTTPProxy(d, urllib_transport)
+    st, body, _ = p.handle("PUT", "/v2/keys/viaproxy", {"value": "pv"})
+    assert st == 201
+    st, body, _ = p.handle("GET", "/v2/keys/viaproxy")
+    assert body["node"]["value"] == "pv"
+    # the dead endpoint is now out of rotation: only one transport hop
+    assert [e.url for e in d.endpoints()] == [etcd.client_url]
