@@ -156,6 +156,24 @@ def main(argv=None) -> int:
                                              choices=("read", "write", "readwrite"))
     rg.add_argument("key"); rg.add_argument("range_end", nargs="?")
 
+    # legacy v2 family (etcdctl/ctlv2 command surface)
+    v2 = sub.add_parser("v2", help="legacy v2 commands over /v2/keys")
+    v2sub = v2.add_subparsers(dest="v2_cmd", required=True)
+    v2g = v2sub.add_parser("get"); v2g.add_argument("key")
+    v2s = v2sub.add_parser("set"); v2s.add_argument("key")
+    v2s.add_argument("value"); v2s.add_argument("--ttl", type=int)
+    v2mk = v2sub.add_parser("mk"); v2mk.add_argument("key")
+    v2mk.add_argument("value")
+    v2md = v2sub.add_parser("mkdir"); v2md.add_argument("key")
+    v2ls = v2sub.add_parser("ls"); v2ls.add_argument("key", nargs="?",
+                                                    default="/")
+    v2ls.add_argument("--recursive", action="store_true")
+    v2rm = v2sub.add_parser("rm"); v2rm.add_argument("key")
+    v2rm.add_argument("--recursive", action="store_true")
+    v2rd = v2sub.add_parser("rmdir"); v2rd.add_argument("key")
+    v2u = v2sub.add_parser("update"); v2u.add_argument("key")
+    v2u.add_argument("value")
+
     args = p.parse_args(argv)
     ctl = Ctl(args.endpoint)
     if args.user:
@@ -319,6 +337,45 @@ def main(argv=None) -> int:
                 perm["range_end"] = b64(args.range_end)
             ctl.call("/v3/auth/role/grant", {"name": args.name, "perm": perm})
             print(f"Role {args.name} updated")
+    elif c == "v2":
+        from etcd_tpu import clientv2
+
+        cli = clientv2.new(args.endpoint)
+        vc = args.v2_cmd
+        try:
+            if vc == "get":
+                print(cli.keys.get(args.key).node.get("value", ""))
+            elif vc == "set":
+                r = cli.keys.set(args.key, args.value, ttl=args.ttl)
+                print(r.node.get("value", ""))
+            elif vc == "mk":
+                r = cli.keys.create(args.key, args.value)
+                print(r.node.get("value", ""))
+            elif vc == "mkdir":
+                cli.keys.set(args.key, None, dir=True,
+                             prev_exist=clientv2.PREV_NO_EXIST)
+                print("")
+            elif vc == "ls":
+                r = cli.keys.get(args.key, recursive=args.recursive,
+                                 sort=True)
+                def walk(n):
+                    for ch in n.get("nodes", []):
+                        print(ch["key"] + ("/" if ch.get("dir") else ""))
+                        walk(ch)
+                walk(r.node)
+            elif vc == "rm":
+                cli.keys.delete(args.key, recursive=args.recursive)
+                print(f"PrevNode.Value: deleted {args.key}")
+            elif vc == "rmdir":
+                cli.keys.delete(args.key, dir=True)
+                print("")
+            elif vc == "update":
+                print(cli.keys.update(args.key, args.value)
+                      .node.get("value", ""))
+        except clientv2.Error as e:
+            print(f"Error: {e.code}: {e.message} ({e.cause}) "
+                  f"[{e.index}]", file=sys.stderr)
+            return 1
     return 0
 
 

@@ -280,3 +280,23 @@ def test_httpproxy_over_wire(etcd):
     assert body["node"]["value"] == "pv"
     # the dead endpoint is now out of rotation: only one transport hop
     assert [e.url for e in d.endpoints()] == [etcd.client_url]
+
+
+def test_etcdctl_v2_commands(etcd, capsys):
+    """etcdctl v2 subcommand family (ctlv2 analog) over the wire."""
+    from etcd_tpu import etcdctl
+
+    ep = ["--endpoint", etcd.client_url, "v2"]
+    assert etcdctl.main([*ep, "set", "/ctl/a", "v1"]) == 0
+    assert etcdctl.main([*ep, "get", "/ctl/a"]) == 0
+    assert capsys.readouterr().out.strip().endswith("v1")
+    assert etcdctl.main([*ep, "mkdir", "/ctl/dir"]) == 0
+    assert etcdctl.main([*ep, "ls", "/ctl", "--recursive"]) == 0
+    out = capsys.readouterr().out
+    assert "/ctl/a" in out and "/ctl/dir/" in out
+    assert etcdctl.main([*ep, "update", "/ctl/a", "v2"]) == 0
+    assert etcdctl.main([*ep, "rm", "/ctl/a"]) == 0
+    assert etcdctl.main([*ep, "rmdir", "/ctl/dir"]) == 0
+    # error path: rm of a missing key exits 1 with the v2 error line
+    assert etcdctl.main([*ep, "rm", "/ctl/nope"]) == 1
+    assert "100" in capsys.readouterr().err
