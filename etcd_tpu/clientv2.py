@@ -204,23 +204,31 @@ class HttpV2Api:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
 
-    def _do(self, method: str, path: str,
-            form: dict | None) -> tuple[int, dict, dict]:
+    def _do(self, method: str, path: str, form: dict | None,
+            as_json: bool = False) -> tuple[int, dict, dict]:
+        import base64
         import json
         import urllib.error
         import urllib.parse
         import urllib.request
 
+        headers = {"Content-Type": "application/json" if as_json
+                   else "application/x-www-form-urlencoded"}
+        form = dict(form) if form else {}
+        ba = form.pop("_basic_auth", None)
+        if ba:
+            headers["Authorization"] = "Basic " + \
+                base64.b64encode(ba.encode()).decode()
         url = self.base_url + path
         data = None
-        if form and method == "GET":
+        if as_json:
+            data = json.dumps(form).encode() if form else None
+        elif form and method == "GET":
             url += "?" + urllib.parse.urlencode(form)
         elif form:
             data = urllib.parse.urlencode(form).encode()
         req = urllib.request.Request(
-            url, data=data, method=method,
-            headers={"Content-Type":
-                     "application/x-www-form-urlencoded"})
+            url, data=data, method=method, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 body, hdrs = json.loads(r.read() or b"{}"), r.headers
@@ -246,27 +254,126 @@ class HttpV2Api:
         return self._do(method, "/v2/members" +
                         (f"/{suffix.strip('/')}" if suffix else ""), form)
 
+    def auth_admin(self, method: str, path: str,
+                   form: dict | None = None) -> tuple[int, dict, dict]:
+        # admin payloads carry JSON (role grant/revoke are nested)
+        return self._do(method, "/v2/auth" + path, form, as_json=True)
+
     def stats(self, which: str) -> tuple[int, dict, dict]:
         return self._do("GET", f"/v2/stats/{which}", None)
 
 
-class ClientV2:
-    """client/v2 Client: the keys + members handles. Accepts an
-    in-process V2Api, an EtcdCluster (wrapped), or an endpoint URL
-    string (wire transport)."""
+class _AuthedApi:
+    """Inject basic-auth creds into every request (client.go's
+    Config.Username/Password carried on the transport)."""
 
-    def __init__(self, ec_or_api):
+    def __init__(self, api, username: str, password: str):
+        self._api = api
+        self._ba = f"{username}:{password}"
+
+    def keys(self, method, key, form=None):
+        form = dict(form or {})
+        form["_basic_auth"] = self._ba
+        return self._api.keys(method, key, form)
+
+    def auth_admin(self, method, path, form=None):
+        form = dict(form or {})
+        form["_basic_auth"] = self._ba
+        return self._api.auth_admin(method, path, form)
+
+    def __getattr__(self, name):
+        return getattr(self._api, name)
+
+
+class AuthAPI:
+    """client/v2 auth_user.go/auth_role.go surface over the gateway's
+    /v2/auth admin routes."""
+
+    def __init__(self, api):
+        self.api = api
+
+    def _do(self, method: str, path: str, form: dict | None = None):
+        status, body, _ = self.api.auth_admin(method, path, form)
+        if status >= 400:
+            raise Error(0, body.get("message", ""), "", 0)
+        return body
+
+    def enabled(self) -> bool:
+        return self._do("GET", "/enable")["enabled"]
+
+    def enable(self) -> None:
+        self._do("PUT", "/enable")
+
+    def disable(self) -> None:
+        self._do("DELETE", "/enable")
+
+    def add_user(self, name: str, password: str,
+                 roles: list[str] | None = None) -> dict:
+        return self._do("PUT", f"/users/{name}",
+                        {"password": password,
+                         "roles": roles or []})
+
+    def get_user(self, name: str) -> dict:
+        return self._do("GET", f"/users/{name}")
+
+    def list_users(self) -> list[str]:
+        return self._do("GET", "/users")["users"]
+
+    def remove_user(self, name: str) -> None:
+        self._do("DELETE", f"/users/{name}")
+
+    def grant_user(self, name: str, roles: list[str]) -> dict:
+        return self._do("PUT", f"/users/{name}", {"grant": roles})
+
+    def revoke_user(self, name: str, roles: list[str]) -> dict:
+        return self._do("PUT", f"/users/{name}", {"revoke": roles})
+
+    def add_role(self, name: str,
+                 permissions: dict | None = None) -> dict:
+        form = {}
+        if permissions is not None:
+            form["permissions"] = permissions
+        return self._do("PUT", f"/roles/{name}", form)
+
+    def get_role(self, name: str) -> dict:
+        return self._do("GET", f"/roles/{name}")
+
+    def list_roles(self) -> list[str]:
+        return self._do("GET", "/roles")["roles"]
+
+    def remove_role(self, name: str) -> None:
+        self._do("DELETE", f"/roles/{name}")
+
+    def grant_role(self, name: str, grant: dict) -> dict:
+        return self._do("PUT", f"/roles/{name}", {"grant": grant})
+
+    def revoke_role(self, name: str, revoke: dict) -> dict:
+        return self._do("PUT", f"/roles/{name}", {"revoke": revoke})
+
+
+class ClientV2:
+    """client/v2 Client: the keys + members + auth handles. Accepts an
+    in-process V2Api, an EtcdCluster (wrapped), or an endpoint URL
+    string (wire transport); username/password ride every request as
+    basic auth."""
+
+    def __init__(self, ec_or_api, username: str | None = None,
+                 password: str | None = None):
         if isinstance(ec_or_api, str):
             api: Any = HttpV2Api(ec_or_api)
-        elif isinstance(ec_or_api, (V2Api, HttpV2Api)):
+        elif isinstance(ec_or_api, (V2Api, HttpV2Api, _AuthedApi)):
             api = ec_or_api
         else:
             api = V2Api(ec_or_api)
+        if username is not None:
+            api = _AuthedApi(api, username, password or "")
         self.api = api
         self.keys = KeysAPI(api)
         self.members = MembersAPI(api)
+        self.auth = AuthAPI(api)
 
 
-def new(ec_or_api) -> ClientV2:
+def new(ec_or_api, username: str | None = None,
+        password: str | None = None) -> ClientV2:
     """client.New analog."""
-    return ClientV2(ec_or_api)
+    return ClientV2(ec_or_api, username, password)

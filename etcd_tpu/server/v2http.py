@@ -28,11 +28,21 @@ from etcd_tpu.server.v2store import (
     EcodeRefreshTTLRequired,
     EcodeRefreshValue,
     EcodeTTLNaN,
+    EcodeUnauthorized,
     Event,
     V2Error,
 )
 
 KEYS_PREFIX = "/v2/keys"
+
+
+def _strlist(v) -> list[str] | None:
+    """Form lists arrive either as JSON lists or comma strings."""
+    if v is None or v == "":
+        return None
+    if isinstance(v, list):
+        return [str(x) for x in v]
+    return [s for s in str(v).split(",") if s]
 
 
 def _get_bool(form: dict, name: str) -> bool:
@@ -107,20 +117,38 @@ def parse_key_request(method: str, form: dict) -> dict:
 
 
 class V2Api:
-    """keysHandler + membersHandler + statsHandler over EtcdCluster."""
+    """keysHandler + membersHandler + statsHandler + the v2auth admin
+    surface (client_auth.go) over EtcdCluster."""
 
     def __init__(self, ec: EtcdCluster):
+        from etcd_tpu.server.v2auth import V2AuthStore
+
         self.ec = ec
+        self.auth = V2AuthStore(ec)
         self._watches: dict[int, Any] = {}
         self._next_watch = 1
+
+    @staticmethod
+    def _creds(form: dict) -> tuple[str, str] | None:
+        ba = form.get("_basic_auth")
+        if not ba:
+            return None
+        user, _, pw = ba.partition(":")
+        return (user, pw)
 
     # ------------------------------------------------------------- keys
     def keys(self, method: str, key: str,
              form: dict | None = None) -> tuple[int, dict, dict]:
         """One /v2/keys request. Returns (status, body, headers)."""
+        from etcd_tpu.server.v2auth import AuthError
+
         form = form or {}
         try:
             r = parse_key_request(method, form)
+            # the basic-auth guard (client_auth.go hasKeyPrefixAccess)
+            self.auth.check_key_access(
+                self._creds(form), key, write=method != "GET",
+                recursive=r["recursive"])
             if method == "GET":
                 return self._get(key, r)
             if method in ("PUT", "POST", "DELETE"):
@@ -133,6 +161,11 @@ class V2Api:
                     refresh=r["refresh"], ttl=r["ttl"])
                 return self._key_event(ev, r)
             raise V2Error(EcodeInvalidField, f"bad method {method}")
+        except AuthError as e:
+            # writeNoAuth: surface as the 110 Unauthorized v2 error
+            err = V2Error(EcodeUnauthorized, str(e),
+                          self._store().current_index)
+            return e.status, err.to_json(), self._headers()
         except V2Error as e:
             return e.status_code(), e.to_json(), self._headers()
         except ServerError as e:
@@ -226,6 +259,76 @@ class V2Api:
             return 405, {"error": "method not allowed"}, self._headers()
         except (ServerError, ConfChangeError, ValueError, KeyError) as e:
             return 500, {"message": str(e)}, self._headers()
+
+    # ------------------------------------------------------- auth admin
+    def auth_admin(self, method: str, path: str,
+                   form: dict | None = None) -> tuple[int, dict, dict]:
+        """/v2/auth/{enable,users[/name],roles[/name]} — the
+        client_auth.go handler surface. Admin ops require root once
+        auth is enabled (hasRootAccess)."""
+        from etcd_tpu.server.v2auth import AuthError
+
+        form = form or {}
+        creds = self._creds(form)
+        a = self.auth
+        try:
+            if not a.is_root(creds):
+                raise AuthError(401, "permission denied")
+            parts = [p for p in path.strip("/").split("/") if p]
+            kind = parts[0] if parts else ""
+            name = parts[1] if len(parts) > 1 else None
+            if kind == "enable":
+                if method == "GET":
+                    return 200, {"enabled": a.auth_enabled()}, \
+                        self._headers()
+                if method == "PUT":
+                    a.enable_auth()
+                    return 200, {"enabled": True}, self._headers()
+                if method == "DELETE":
+                    a.disable_auth()
+                    return 200, {"enabled": False}, self._headers()
+            if kind == "users":
+                if method == "GET" and name is None:
+                    return 200, {"users": a.all_users()}, self._headers()
+                if method == "GET":
+                    u = dict(a.get_user(name))
+                    u.pop("password", None)
+                    return 200, u, self._headers()
+                if method == "PUT":
+                    if form.get("grant") or form.get("revoke") or \
+                            a._get(f"/users/{name}") is not None:
+                        out = a.update_user(
+                            name, password=form.get("password"),
+                            grant=_strlist(form.get("grant")),
+                            revoke=_strlist(form.get("revoke")))
+                        return 200, out, self._headers()
+                    out = a.create_user(
+                        name, form.get("password", ""),
+                        _strlist(form.get("roles")))
+                    return 201, out, self._headers()
+                if method == "DELETE":
+                    a.delete_user(name)
+                    return 200, {}, self._headers()
+            if kind == "roles":
+                if method == "GET" and name is None:
+                    return 200, {"roles": a.all_roles()}, self._headers()
+                if method == "GET":
+                    return 200, a.get_role(name), self._headers()
+                if method == "PUT":
+                    if form.get("grant") or form.get("revoke"):
+                        out = a.update_role(name,
+                                            grant=form.get("grant"),
+                                            revoke=form.get("revoke"))
+                        return 200, out, self._headers()
+                    out = a.create_role(name, form.get("permissions"))
+                    return 201, out, self._headers()
+                if method == "DELETE":
+                    a.delete_role(name)
+                    return 200, {}, self._headers()
+            return 404, {"message": f"unknown auth path {path}"}, \
+                self._headers()
+        except AuthError as e:
+            return e.status, {"message": str(e)}, self._headers()
 
     # ------------------------------------------------------------ stats
     def stats(self, which: str) -> tuple[int, dict, dict]:

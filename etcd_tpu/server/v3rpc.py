@@ -558,6 +558,15 @@ class V3Server:
                     else:
                         form.update(parse_qsl(body,
                                               keep_blank_values=True))
+                auth = self.headers.get("Authorization", "")
+                if auth.startswith("Basic "):
+                    import base64 as _b64
+
+                    try:
+                        form["_basic_auth"] = _b64.b64decode(
+                            auth[6:]).decode()
+                    except Exception:
+                        pass
                 return form
 
             def _maybe_v2(self) -> bool:
@@ -591,6 +600,13 @@ class V3Server:
                 if path.startswith("/v2/stats/"):
                     with api.lock:
                         st, body, hdr = v2api.stats(path.rsplit("/", 1)[1])
+                    self._send(st, body, hdr)
+                    return True
+                if path.startswith("/v2/auth/"):
+                    with api.lock:
+                        st, body, hdr = v2api.auth_admin(
+                            self.command, path[len("/v2/auth"):],
+                            self._v2_form())
                     self._send(st, body, hdr)
                     return True
                 return False

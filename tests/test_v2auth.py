@@ -1,0 +1,169 @@
+"""v2 auth: users/roles/guards over the replicated security subtree
+(api/v2auth/auth.go + v2http/client_auth.go)."""
+import pytest
+
+from etcd_tpu import clientv2
+from etcd_tpu.server.kvserver import EtcdCluster
+from etcd_tpu.server.v2auth import (
+    AuthError,
+    V2AuthStore,
+    has_access,
+    prefix_match,
+    simple_match,
+)
+from etcd_tpu.server.v2http import V2Api
+from etcd_tpu.server.v2store import EcodeUnauthorized
+
+
+@pytest.fixture()
+def ec():
+    c = EtcdCluster(n_members=3)
+    c.ensure_leader()
+    return c
+
+
+@pytest.fixture()
+def auth(ec):
+    return V2AuthStore(ec)
+
+
+# ------------------------------------------------------- pattern match
+
+def test_match_semantics():
+    assert simple_match("/foo/*", "/foo/bar")
+    assert simple_match("/foo", "/foo")
+    assert not simple_match("/foo", "/foo/bar")
+    assert prefix_match("/foo*", "/foo")
+    assert not prefix_match("/foo/*", "/foo")  # the reference quirk
+    assert not prefix_match("/foo", "/foo")
+
+
+def test_has_access():
+    perms = {"kv": {"read": ["/r/*"], "write": ["/w/only"]}}
+    assert has_access(perms, "/r/x", write=False)
+    assert not has_access(perms, "/r/x", write=True)
+    assert has_access(perms, "/w/only", write=True)
+    assert not has_access(perms, "/w/other", write=True)
+
+
+# ------------------------------------------------------------ store
+
+def test_user_role_crud(auth):
+    auth.create_user("alice", "pw", ["r1"])
+    u = auth.get_user("alice")
+    assert u["roles"] == ["r1"]
+    with pytest.raises(AuthError, match="already exists"):
+        auth.create_user("alice", "pw2")
+    auth.update_user("alice", grant=["r2"])
+    assert auth.get_user("alice")["roles"] == ["r1", "r2"]
+    with pytest.raises(AuthError, match="duplicate role"):
+        auth.update_user("alice", grant=["r2"])
+    auth.update_user("alice", revoke=["r1"])
+    assert auth.get_user("alice")["roles"] == ["r2"]
+    assert auth.all_users() == ["alice"]
+    auth.delete_user("alice")
+    with pytest.raises(AuthError, match="does not exist"):
+        auth.get_user("alice")
+
+    auth.create_role("reader", {"kv": {"read": ["/a/*"], "write": []}})
+    r = auth.get_role("reader")
+    assert r["permissions"]["kv"]["read"] == ["/a/*"]
+    auth.update_role("reader",
+                     grant={"kv": {"read": ["/b/*"], "write": []}})
+    assert auth.get_role("reader")["permissions"]["kv"]["read"] == \
+        ["/a/*", "/b/*"]
+    with pytest.raises(AuthError, match="duplicate permission"):
+        auth.update_role("reader",
+                         grant={"kv": {"read": ["/b/*"], "write": []}})
+    with pytest.raises(AuthError, match="invalid role name"):
+        auth.create_role("root")
+    assert "root" in auth.all_roles()
+
+
+def test_enable_requires_root(auth):
+    with pytest.raises(AuthError, match="No root user"):
+        auth.enable_auth()
+    auth.create_user("root", "rpw")
+    auth.enable_auth()
+    assert auth.auth_enabled()
+    # guest role auto-created with full access
+    assert auth.get_role("guest")["permissions"]["kv"]["read"] == ["/*"]
+    with pytest.raises(AuthError, match="already enabled"):
+        auth.enable_auth()
+    with pytest.raises(AuthError, match="cannot delete root"):
+        auth.delete_user("root")
+    auth.disable_auth()
+    assert not auth.auth_enabled()
+
+
+def test_guard(auth):
+    auth.create_user("root", "rpw")
+    auth.create_user("bob", "bpw", ["writer"])
+    auth.create_role("writer",
+                     {"kv": {"read": ["/app/*"], "write": ["/app/*"]}})
+    auth.enable_auth()
+    # default guest role is full-access: everything still allowed
+    auth.check_key_access(None, "/anything", write=True)
+    # restrict guests to read-only
+    auth.update_role("guest",
+                     revoke={"kv": {"read": [], "write": ["/*"]}})
+    with pytest.raises(AuthError):
+        auth.check_key_access(None, "/app/x", write=True)
+    auth.check_key_access(None, "/app/x", write=False)
+    # bob can write inside /app, nowhere else
+    auth.check_key_access(("bob", "bpw"), "/app/x", write=True)
+    with pytest.raises(AuthError):
+        auth.check_key_access(("bob", "bpw"), "/other", write=True)
+    with pytest.raises(AuthError, match="incorrect password"):
+        auth.check_key_access(("bob", "WRONG"), "/app/x", write=True)
+    # root bypasses everything; the security subtree stays internal
+    auth.check_key_access(("root", "rpw"), "/other", write=True)
+    with pytest.raises(AuthError):
+        auth.check_key_access(("root", "rpw"), "/_security/users/x",
+                              write=False)
+
+
+def test_guard_replicates(ec, auth):
+    """Auth records live in the replicated tree: every member agrees."""
+    auth.create_user("root", "rpw")
+    auth.enable_auth()
+    ec.stabilize()
+    saves = [ms.v2store.save() for ms in ec.members]
+    assert saves[0] == saves[1] == saves[2]
+    assert V2AuthStore(ec).auth_enabled()
+
+
+# ------------------------------------------------------------- façade
+
+def test_v2api_guard_and_admin(ec):
+    api = V2Api(ec)
+    root = clientv2.new(api, "root", "rpw")
+    anon = clientv2.new(api)
+    # before enable: admin open, keys open
+    root.auth.add_user("root", "rpw")
+    root.auth.add_role("writer",
+                       {"kv": {"read": ["/app/*"],
+                               "write": ["/app/*"]}})
+    root.auth.add_user("bob", "bpw", ["writer"])
+    root.auth.enable()
+    assert root.auth.enabled()
+    # lock guests out of writes
+    root.auth.revoke_role("guest",
+                          {"kv": {"read": [], "write": ["/*"]}})
+    st, body, _ = api.keys("PUT", "/app/x", {"value": "v"})
+    assert st == 403 and body["errorCode"] == EcodeUnauthorized
+    bob = clientv2.new(api, "bob", "bpw")
+    assert bob.keys.set("/app/x", "v").action == "set"
+    with pytest.raises(clientv2.Error):
+        bob.keys.set("/elsewhere", "v")
+    assert anon.keys.get("/app/x").node["value"] == "v"
+    # admin requires root now
+    st, body, _ = api.auth_admin("GET", "/users", {})
+    assert st == 401
+    assert bob.auth is not None
+    with pytest.raises(clientv2.Error):
+        bob.auth.list_users()
+    assert root.auth.list_users() == ["bob", "root"]
+    assert root.auth.get_user("bob")["roles"] == ["writer"]
+    root.auth.disable()
+    assert api.keys("PUT", "/free", {"value": "v"})[0] == 201
