@@ -148,6 +148,11 @@ def main() -> None:
         cfg,
         local_steps=("prop",),
         message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP),
+        # emission restructure (PROFILE.md round 4): scan-body handlers
+        # record PendingWire intents; one post-scan merge materializes
+        # them. Bit-exact on steady traffic (tests/test_deferred_emit.py).
+        # BENCH_DEFERRED=0 reverts to immediate emission for A/B runs.
+        deferred_emit=os.environ.get("BENCH_DEFERRED", "1") != "0",
     )
     run = build_scan_rounds(steady_cfg, spec, mesh, rounds=inner)
     args = (prop_len, prop_data, zp, z2, no_hup, no_tick, keep)

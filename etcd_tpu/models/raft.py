@@ -660,17 +660,37 @@ def _release_pending_read_index(cfg, spec, n, ob, enable):
 # ---------------------------------------------------------------------------
 
 
+def _pend_reply(spec, ob: Outbox, to, enable, term, index, reject,
+                hint, logterm) -> Outbox:
+    """Record a MsgAppResp intent in the deferred accumulator
+    (last-writer-wins per destination; see PendingWire)."""
+    p = ob.pend
+    hot = (jnp.arange(spec.M, dtype=jnp.int32) == to) & enable
+    p = p.replace(
+        rep_any=p.rep_any | hot,
+        rep_term=jnp.where(hot, term, p.rep_term),
+        rep_index=jnp.where(hot, index, p.rep_index),
+        rep_reject=jnp.where(hot, reject, p.rep_reject),
+        rep_hint=jnp.where(hot, hint, p.rep_hint),
+        rep_logterm=jnp.where(hot, logterm, p.rep_logterm),
+    )
+    return ob.replace(pend=p)
+
+
 def handle_append_entries(cfg, spec, n, ob, m: Msg, enable):
     """raft.handleAppendEntries (raft.go:1475-1511)."""
     below = m.index < n.commit
-    ob = emit_one(
-        spec,
-        ob,
-        m.frm,
-        make_msg(spec, type=MSG_APP_RESP, term=n.term, frm=n.nid, index=n.commit),
-        enable & below,
-        fields=("index",),
-    )
+    commit0 = n.commit  # the below-commit reply carries pre-append commit
+    if not cfg.deferred_emit:
+        ob = emit_one(
+            spec,
+            ob,
+            m.frm,
+            make_msg(spec, type=MSG_APP_RESP, term=n.term, frm=n.nid,
+                     index=n.commit),
+            enable & below,
+            fields=("index",),
+        )
     en = enable & ~below
     # ring-capacity partial accept: entries past snap_index + L can't be
     # stored; accept the storable prefix (size-limited appends are legal).
@@ -679,6 +699,17 @@ def handle_append_entries(cfg, spec, n, ob, m: Msg, enable):
         spec, n, m.index, m.log_term, m.commit, eff_len, m.ent_term, m.ent_data,
         m.ent_type, en,
     )
+    hint_index = jnp.minimum(m.index, n.last_index)
+    hint_index = logops.find_conflict_by_term(spec, n, hint_index, m.log_term)
+    hint_term, _ = logops.term_at(spec, n, hint_index)
+    if cfg.deferred_emit:
+        # one recorded reply covers the three exclusive cases
+        rej = en & ~ok
+        idx = jnp.where(below, commit0, jnp.where(ok, lastnewi, m.index))
+        ob = _pend_reply(spec, ob, m.frm, enable, n.term, idx, rej,
+                         jnp.where(rej, hint_index, 0),
+                         jnp.where(rej, hint_term, 0))
+        return n, ob
     ob = emit_one(
         spec,
         ob,
@@ -687,9 +718,6 @@ def handle_append_entries(cfg, spec, n, ob, m: Msg, enable):
         en & ok,
         fields=("index",),
     )
-    hint_index = jnp.minimum(m.index, n.last_index)
-    hint_index = logops.find_conflict_by_term(spec, n, hint_index, m.log_term)
-    hint_term, _ = logops.term_at(spec, n, hint_index)
     ob = emit_one(
         spec,
         ob,
@@ -838,7 +866,15 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
             pend = pend | keep
         n = n.replace(pending_conf_index=jnp.where(doprop, new_pci, n.pending_conf_index))
         n, accepted = append_entries_state(cfg, spec, n, m.ent_len, e_data, e_type, doprop)
-        n, ob = bcast_append(cfg, spec, n, ob, doprop & accepted)
+        if cfg.deferred_emit:
+            dest = _progress_ids(n) & (doprop & accepted)
+            p = ob.pend
+            ob = ob.replace(pend=p.replace(
+                send_dest=p.send_dest | dest,
+                send_nonempty=p.send_nonempty | dest,
+            ))
+        else:
+            n, ob = bcast_append(cfg, spec, n, ob, doprop & accepted)
 
     # ---- MsgReadIndex (raft.go:1078-1097)
     if _handles(cfg, MSG_READ_INDEX):
@@ -931,7 +967,13 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
         n2, committed_adv = maybe_commit_state(cfg, spec, n)
         committed_adv = committed_adv & updated
         n = tree_where(committed_adv, n2, n)
-        n, ob = _release_pending_read_index(cfg, spec, n, ob, committed_adv)
+        if _handles(cfg, MSG_READ_INDEX):
+            # the pending-read queue only fills while handling
+            # MsgReadIndex; a program whose classes exclude it can never
+            # have entries to release, so the R-deep masked release pass
+            # drops at trace time with the other dead handler blocks
+            n, ob = _release_pending_read_index(cfg, spec, n, ob,
+                                                committed_adv)
 
         # merged send: commit-advance broadcast (raft.go:1259-1263) OR
         # refresh/drain to the acking follower (1264-1276) OR the reject-path
@@ -946,18 +988,32 @@ def _step_leader(cfg, spec, n: NodeState, ob: Outbox, m: Msg, en):
                 committed_adv, _progress_ids(n), fhot & (updated | decremented)
             )
             send_nonempty = committed_adv | decremented | old_paused_f
-        n, ob = maybe_send_append(cfg, spec, n, ob, send_dest, send_nonempty)
+        if cfg.deferred_emit:
+            # accumulate; node_round's flush runs ONE merged
+            # maybe_send_append over the union after the scan
+            p = ob.pend
+            ob = ob.replace(pend=p.replace(
+                send_dest=p.send_dest | send_dest,
+                send_nonempty=p.send_nonempty | (send_dest & send_nonempty),
+            ))
+        else:
+            n, ob = maybe_send_append(cfg, spec, n, ob, send_dest,
+                                      send_nonempty)
 
-        # leadership transfer (raft.go:1278-1281)
-        xfer = updated & (m.frm == n.lead_transferee) & (onehot_sel(n.match, frm_c) == n.last_index)
-        ob = emit_one(
-            spec,
-            ob,
-            m.frm,
-            make_msg(spec, type=MSG_TIMEOUT_NOW, term=n.term, frm=n.nid),
-            xfer,
-            fields=(),
-        )
+        if not cfg.deferred_emit or _handles(cfg, MSG_TRANSFER_LEADER):
+            # leadership transfer (raft.go:1278-1281); under deferred_emit
+            # a transfer can only be in flight if MsgTransferLeader is a
+            # handled class (see RaftConfig.deferred_emit preconditions)
+            xfer = updated & (m.frm == n.lead_transferee) & \
+                (onehot_sel(n.match, frm_c) == n.last_index)
+            ob = emit_one(
+                spec,
+                ob,
+                m.frm,
+                make_msg(spec, type=MSG_TIMEOUT_NOW, term=n.term, frm=n.nid),
+                xfer,
+                fields=(),
+            )
 
     if _handles(cfg, MSG_HEARTBEAT_RESP):
         # ---- MsgHeartbeatResp (raft.go:1284-1309)
@@ -1074,9 +1130,26 @@ def _step_follower(cfg, spec, n, ob, m: Msg, en):
     if _handles(cfg, MSG_PROP):
         is_prop = en & (m.type == MSG_PROP)
         fwd_ok = (n.lead != NONE_ID) & (not cfg.disable_proposal_forwarding)
-        ob = emit_one(
-            spec, ob, n.lead, m.replace(frm=n.nid, term=jnp.int32(0)), is_prop & fwd_ok
-        )
+        if cfg.deferred_emit:
+            # record the forward intent; the flush emits one MsgProp per
+            # destination (an earlier same-round forward to the same
+            # leader is superseded — proposals are drop-legal)
+            p = ob.pend
+            hot = (jnp.arange(spec.M, dtype=jnp.int32) == n.lead) & \
+                (is_prop & fwd_ok)
+            ob = ob.replace(pend=p.replace(
+                fwd_any=p.fwd_any | hot,
+                fwd_len=jnp.where(hot, m.ent_len, p.fwd_len),
+                fwd_data=jnp.where(hot[:, None], m.ent_data[None, :],
+                                   p.fwd_data),
+                fwd_type=jnp.where(hot[:, None], m.ent_type[None, :],
+                                   p.fwd_type),
+            ))
+        else:
+            ob = emit_one(
+                spec, ob, n.lead, m.replace(frm=n.nid, term=jnp.int32(0)),
+                is_prop & fwd_ok,
+            )
 
     # MsgApp/MsgHeartbeat/MsgSnap from the leader (raft.go:1433-1444)
     lead_msg = en & (
@@ -1153,14 +1226,19 @@ def process_message(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox, m: Ms
             & (cfg.check_quorum or cfg.pre_vote)
             & ((m.type == MSG_HEARTBEAT) | (m.type == MSG_APP))
         )
-        ob = emit_one(
-            spec,
-            ob,
-            m.frm,
-            make_msg(spec, type=MSG_APP_RESP, term=n.term, frm=n.nid),
-            lt_push,
-            fields=(),
-        )
+        if cfg.deferred_emit:
+            ob = _pend_reply(spec, ob, m.frm, lt_push, n.term,
+                             jnp.int32(0), jnp.zeros((), jnp.bool_),
+                             jnp.int32(0), jnp.int32(0))
+        else:
+            ob = emit_one(
+                spec,
+                ob,
+                m.frm,
+                make_msg(spec, type=MSG_APP_RESP, term=n.term, frm=n.nid),
+                lt_push,
+                fields=(),
+            )
     if _handles(cfg, MSG_PRE_VOTE):
         lt_prevote = lower & (m.type == MSG_PRE_VOTE)
         ob = emit_one(
@@ -1399,6 +1477,38 @@ def compact_inbox(spec: Spec, flat: Msg, bound: int) -> Msg:
     return jax.tree.map(take, flat)
 
 
+def _flush_deferred(cfg, spec, n: NodeState, ob: Outbox):
+    """Materialize the PendingWire intents accumulated during the message
+    scan: ONE AppResp emit + ONE proposal-forward emit + ONE merged
+    maybe_send_append (the post-scan merge of PROFILE.md's emission
+    restructure). Runs once per round, outside the scan carry."""
+    p = ob.pend
+    base = bcast(spec, make_msg(spec))
+    rep = base.replace(
+        type=jnp.where(p.rep_any, MSG_APP_RESP, MSG_NONE),
+        term=p.rep_term,
+        frm=jnp.broadcast_to(n.nid, (spec.M,)),
+        index=p.rep_index,
+        reject=p.rep_reject,
+        reject_hint=p.rep_hint,
+        log_term=p.rep_logterm,
+    )
+    ob = emit(spec, ob, p.rep_any, rep,
+              fields=("index", "reject_hint", "log_term"))
+    fwd = base.replace(
+        type=jnp.where(p.fwd_any, MSG_PROP, MSG_NONE),
+        frm=jnp.broadcast_to(n.nid, (spec.M,)),
+        ent_len=p.fwd_len,
+        ent_data=p.fwd_data,
+        ent_type=p.fwd_type,
+    )
+    ob = emit(spec, ob, p.fwd_any, fwd,
+              fields=("ent_len", "ent_data", "ent_type"))
+    n, ob = maybe_send_append(cfg, spec, n, ob, p.send_dest,
+                              p.send_nonempty)
+    return n, ob
+
+
 def node_round(
     cfg: RaftConfig,
     spec: Spec,
@@ -1413,7 +1523,7 @@ def node_round(
 ):
     """One lockstep round for one node: tick -> [hup, inbox..., prop,
     read-index] message scan -> apply. Returns (state, outbox)."""
-    ob = empty_outbox(spec)
+    ob = empty_outbox(spec, deferred=cfg.deferred_emit)
     if "tick" in cfg.local_steps:
         n, ob, fire = tick_timers(
             cfg, spec, n, ob, jnp.asarray(do_tick, jnp.bool_)
@@ -1479,6 +1589,9 @@ def node_round(
         n, ob = process_message(cfg, spec, n, ob, prop_msg)
     if do_ri_step:
         n, ob = process_message(cfg, spec, n, ob, ri_msg)
+
+    if cfg.deferred_emit:
+        n, ob = _flush_deferred(cfg, spec, n, ob)
 
     if cfg.coalesce_commit_refresh:
         # End-of-round commit flush, replacing the per-ack bcastAppend

@@ -24,6 +24,45 @@ from flax import struct
 from etcd_tpu.types import ENT_FIELDS as _ENT_FIELDS, Msg, NONE_ID, Spec, empty_msg
 
 
+class PendingWire(struct.PyTreeNode):
+    """Deferred-emission accumulator (RaftConfig.deferred_emit): instead
+    of writing [K, M] message planes inside the serial message scan, the
+    steady-state handlers record per-destination reply/send intents in
+    these [M]-vectors; node_round materializes them with ONE post-scan
+    emit + ONE maybe_send_append (the emission restructure named in
+    PROFILE.md). Last-writer-wins per destination — legal because the
+    transport may drop messages, and exact in the steady state where
+    each peer receives at most one reply-worthy message per round."""
+
+    # MsgAppResp reply intent (handle_append_entries + the lower-term
+    # commit push of process_message)
+    rep_any: jnp.ndarray      # bool[M]
+    rep_term: jnp.ndarray     # i32[M]
+    rep_index: jnp.ndarray    # i32[M]
+    rep_reject: jnp.ndarray   # bool[M]
+    rep_hint: jnp.ndarray     # i32[M]
+    rep_logterm: jnp.ndarray  # i32[M]
+    # union of maybe_send_append destinations requested mid-scan
+    # (stepLeader's ack/reject merged send + in-scan bcastAppend)
+    send_dest: jnp.ndarray      # bool[M]
+    send_nonempty: jnp.ndarray  # bool[M]
+    # follower proposal forward intent (stepFollower raft.go:1423-1432)
+    fwd_any: jnp.ndarray    # bool[M]
+    fwd_len: jnp.ndarray    # i32[M]
+    fwd_data: jnp.ndarray   # i32[M, E]
+    fwd_type: jnp.ndarray   # i32[M, E]
+
+
+def empty_pending(spec: Spec) -> PendingWire:
+    z = jnp.zeros((spec.M,), jnp.int32)
+    b = jnp.zeros((spec.M,), jnp.bool_)
+    ze = jnp.zeros((spec.M, spec.E), jnp.int32)
+    return PendingWire(rep_any=b, rep_term=z, rep_index=z, rep_reject=b,
+                       rep_hint=z, rep_logterm=z, send_dest=b,
+                       send_nonempty=b, fwd_any=b, fwd_len=z,
+                       fwd_data=ze, fwd_type=ze)
+
+
 class Outbox(struct.PyTreeNode):
     # msgs leaves are stored FLAT: [K*M(dest)] (ent fields [K*M*E]) —
     # the outbox is a lax.scan carry in node_round, and a carry leaf whose
@@ -39,6 +78,8 @@ class Outbox(struct.PyTreeNode):
     # flush (RaftConfig.coalesce_commit_refresh) to detect destinations
     # whose only messages this round predate a commit advance.
     sent_commit: jnp.ndarray  # i32[M]
+    # deferred-emission accumulator; None unless cfg.deferred_emit
+    pend: PendingWire | None = None
 
 
 def _view(spec: Spec, name: str, x: jnp.ndarray) -> jnp.ndarray:
@@ -47,7 +88,7 @@ def _view(spec: Spec, name: str, x: jnp.ndarray) -> jnp.ndarray:
     return x.reshape(spec.K, spec.M)
 
 
-def empty_outbox(spec: Spec) -> Outbox:
+def empty_outbox(spec: Spec, deferred: bool = False) -> Outbox:
     m = empty_msg(spec)
 
     def mk(name, x):
@@ -56,7 +97,8 @@ def empty_outbox(spec: Spec) -> Outbox:
 
     msgs = Msg(**{k: mk(k, getattr(m, k)) for k in Msg.__dataclass_fields__})
     return Outbox(msgs=msgs, counts=jnp.zeros((spec.M,), jnp.int32),
-                  sent_commit=jnp.zeros((spec.M,), jnp.int32))
+                  sent_commit=jnp.zeros((spec.M,), jnp.int32),
+                  pend=empty_pending(spec) if deferred else None)
 
 
 def make_msg(spec: Spec, **kw) -> Msg:
@@ -110,8 +152,7 @@ def emit(spec: Spec, ob: Outbox, to_mask: jnp.ndarray, m: Msg,
         else tuple(dict.fromkeys(HEADER_FIELDS + tuple(fields)))
     )
     msgs = ob.msgs.replace(**{k: upd(k) for k in names})
-    return Outbox(msgs=msgs, counts=ob.counts + can.astype(jnp.int32),
-                  sent_commit=ob.sent_commit)
+    return ob.replace(msgs=msgs, counts=ob.counts + can.astype(jnp.int32))
 
 
 def record_sent_commit(ob: Outbox, mask: jnp.ndarray, value) -> Outbox:

@@ -94,6 +94,23 @@ class RaftConfig:
     # cross-device traffic (the 8-chip mesh holds 131k/chip and needs no
     # chunking). 1 disables.
     fleet_chunks: int = 1
+    # The emission restructure (PROFILE.md): handlers inside the serial
+    # message scan record per-destination reply/send intents in small
+    # [M]-vectors (ops/outbox.py PendingWire) instead of writing [K, M]
+    # message planes, and node_round materializes them with ONE
+    # post-scan AppResp emit + ONE merged maybe_send_append + ONE
+    # proposal-forward emit. With the steady message_classes this leaves
+    # ZERO outbox writes inside the scan, so the scan carry shrinks to
+    # NodeState + a dozen [M]-vectors. Semantics: last-writer-wins per
+    # destination — coalescing is legal by the transport drop contract,
+    # and BIT-IDENTICAL in the steady state where each peer receives at
+    # most one reply-worthy message per round
+    # (tests/test_deferred_emit.py). PRECONDITIONS (like local_steps):
+    # requires coalesce_commit_refresh; assumes no in-flight leadership
+    # transfer (the MsgTimeoutNow emit is compiled out — sound because
+    # MSG_TRANSFER_LEADER is not in any steady message_classes, so no
+    # transfer can start). Off for golden/test paths.
+    deferred_emit: bool = False
     # Store the carried inter-round message tensor (the "wire") as int16
     # instead of int32: halves the resident inbox, which at the 1M-group
     # configuration is the largest single fleet buffer. Casts happen at
@@ -135,6 +152,13 @@ class RaftConfig:
                         "is not in message_classes — its messages would be "
                         "silently swallowed"
                     )
+        if self.deferred_emit and not self.coalesce_commit_refresh:
+            # without coalescing, the leader's per-ack commit broadcast
+            # fires inside the scan — exactly the write the deferral is
+            # supposed to remove, and its send set depends on mid-scan
+            # commit state that the post-scan flush cannot reconstruct
+            raise ValueError("deferred_emit requires "
+                             "coalesce_commit_refresh")
 
     @property
     def max_uncommitted_entries(self) -> int:

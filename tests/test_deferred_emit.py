@@ -1,0 +1,111 @@
+"""RaftConfig.deferred_emit: the emission restructure (PROFILE.md).
+
+Equivalence contract: on live steady traffic (one append + one ack per
+follower per round), the deferred-emission program — per-destination
+PendingWire intents in the scan, one post-scan AppResp emit + merged
+maybe_send_append — reproduces the immediate-emission steady program
+bit-for-bit in both fleet state and the wire (inbox) tensors. The scan
+body then writes no outbox planes at all, which is the point."""
+import dataclasses
+
+import numpy as np
+import jax
+import pytest
+
+from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
+from etcd_tpu.types import (
+    ENTRY_NORMAL,
+    MSG_APP,
+    MSG_APP_RESP,
+    MSG_PROP,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import RaftConfig
+
+SPEC = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+FULL = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                  inbox_bound=4, coalesce_commit_refresh=True)
+STEADY = dataclasses.replace(
+    FULL, local_steps=("prop",),
+    message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP))
+DEFERRED = dataclasses.replace(STEADY, deferred_emit=True)
+C = 4
+
+
+def _elect(full):
+    M, E = SPEC.M, SPEC.E
+    state = init_fleet(SPEC, C, seed=0, election_tick=FULL.election_tick)
+    inbox = empty_inbox(SPEC, C)
+    z2 = np.zeros((M, C), np.int32)
+    zp = np.zeros((M, E, C), np.int32)
+    no = np.zeros((M, C), bool)
+    keep = np.ones((M, M, C), bool)
+    hup = no.copy()
+    hup[0, :] = True
+    state, inbox = full(state, inbox, z2, zp, zp, z2, hup, no, keep)
+    for _ in range(12):
+        state, inbox = full(state, inbox, z2, zp, zp, z2, no, no, keep)
+    assert (np.asarray(state.role)[0] == ROLE_LEADER).all()
+    return state, inbox, (z2, zp, no, keep)
+
+
+def test_deferred_emit_requires_coalescing():
+    with pytest.raises(ValueError, match="coalesce"):
+        RaftConfig(deferred_emit=True)
+
+
+def test_deferred_program_is_bit_identical_in_steady_state():
+    full = jax.jit(build_round(FULL, SPEC))
+    steady = jax.jit(build_round(STEADY, SPEC))
+    deferred = jax.jit(build_round(DEFERRED, SPEC))
+    state0, inbox0, (z2, zp, no, keep) = _elect(full)
+
+    plen = z2.copy()
+    plen[0, :] = 1
+    pdata = zp.copy()
+    pdata[0, 0, :] = 7
+    ptype = zp.copy()
+    ptype[0, 0, :] = ENTRY_NORMAL
+
+    sa, ia = state0, inbox0
+    sb, ib = state0, inbox0
+    for r in range(10):
+        sa, ia = steady(sa, ia, plen, pdata, ptype, z2, no, no, keep)
+        sb, ib = deferred(sb, ib, plen, pdata, ptype, z2, no, no, keep)
+    assert int(np.asarray(sa.commit).min()) >= 8  # really replicating
+    for name in sa.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(sa, name)), np.asarray(getattr(sb, name))
+        ), f"state.{name}"
+    for name in ia.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(ia, name)), np.asarray(getattr(ib, name))
+        ), f"inbox.{name}"
+
+
+def test_deferred_program_heals_a_dropped_append():
+    """Past bit-exactness: with one follower's inbound append dropped for
+    a round (reject/probe path), the deferred program still converges all
+    commits — the coalesced reply/send machinery heals like the immediate
+    one."""
+    deferred = jax.jit(build_round(DEFERRED, SPEC))
+    full = jax.jit(build_round(FULL, SPEC))
+    state, inbox, (z2, zp, no, keep) = _elect(full)
+
+    plen = z2.copy()
+    plen[0, :] = 1
+    pdata = zp.copy()
+    pdata[0, 0, :] = 9
+    ptype = zp.copy()
+    ptype[0, 0, :] = ENTRY_NORMAL
+
+    drop = keep.copy()
+    drop[:, 2, :] = False  # member 2 receives nothing this round
+    state, inbox = deferred(state, inbox, plen, pdata, ptype, z2, no, no,
+                            drop)
+    for _ in range(6):
+        state, inbox = deferred(state, inbox, z2, zp, zp, z2, no, no,
+                                keep)
+    commits = np.asarray(state.commit)
+    assert (commits[2] == commits[0]).all()  # the dropped member caught up
