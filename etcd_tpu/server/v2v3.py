@@ -1,0 +1,510 @@
+"""v2 API emulated on the v3 store — the api/v2v3 analog.
+
+Re-design of ``server/etcdserver/api/v2v3/store.go``: serve the v2store
+surface (Get/Set/Update/Create/CompareAndSwap/CompareAndDelete/Delete/
+Watch) from the replicated **v3 MVCC** store instead of the legacy v2
+tree. The key encoding is the reference's depth scheme
+(store.go mkPathDepth): a v2 path at directory depth ``n`` lives at
+``{pfx}/{n:03d}/k{path}`` so one prefix range lists a directory level;
+directory markers are ``...{path}/`` keys; every mutation also writes
+``{pfx}/act`` with the v2 action name inside the same txn so watchers
+can recover the action (store.go mkActionKey + watcher.go); v2 indexes
+are v3 revisions shifted by one (mkV2Rev/mkV3Rev, store.go:592-604).
+
+Mutations ride v3 txns (Compare on create/mod revision stands in for
+the reference's STM), so everything replicates through the same device
+consensus path as any other v3 write.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from etcd_tpu.server.kvserver import Compare, EtcdCluster, Op
+from etcd_tpu.server.v2store import (
+    EcodeDirNotEmpty,
+    EcodeKeyNotFound,
+    EcodeNodeExist,
+    EcodeNotDir,
+    EcodeNotFile,
+    EcodeRootROnly,
+    EcodeTestFailed,
+    Event,
+    V2Error,
+    _clean_path,
+)
+
+MAX_DEPTH = 64  # recursive-listing depth bound (v2 paths are shallow)
+
+
+def mk_v2_rev(v3_rev: int) -> int:
+    return 0 if v3_rev == 0 else v3_rev - 1
+
+
+def mk_v3_rev(v2_rev: int) -> int:
+    return 0 if v2_rev == 0 else v2_rev + 1
+
+
+def _is_root(p: str) -> bool:
+    return p in ("", "/", "/0", "/1")
+
+
+class V2v3Store:
+    """store.go v2v3Store over an in-process EtcdCluster."""
+
+    def __init__(self, ec: EtcdCluster, pfx: str = "/__v2"):
+        self.ec = ec
+        self.pfx = pfx.rstrip("/")
+
+    # ---- key encoding (store.go:566-590)
+    def _depth(self, node_path: str) -> int:
+        return _clean_path(node_path).count("/")
+
+    def _mk_path(self, node_path: str, depth: int = 0) -> bytes:
+        normal = _clean_path(node_path)
+        n = normal.count("/") + depth
+        return f"{self.pfx}/{n:03d}/k{normal}".encode()
+
+    def _node_path(self, key: bytes) -> str:
+        # strip "{pfx}/{ddd}/k" prefix
+        s = key.decode()
+        return _clean_path(s[len(self.pfx) + 5 + 1:])
+
+    def _act_key(self) -> bytes:
+        return (self.pfx + "/act").encode()
+
+    # ---- small kv helpers
+    def _get_kv(self, key: bytes):
+        kvs = self.ec.range(key)["kvs"]
+        return kvs[0] if kvs else None
+
+    def _rev(self) -> int:
+        m = self.ec.ensure_leader()
+        return self.ec.members[m].store.kv.current_rev
+
+    def _txn(self, compare, success, failure=()) -> dict:
+        return self.ec.txn(list(compare), list(success), list(failure))
+
+    def _dir_key(self, node_path: str) -> bytes:
+        # a directory marker is the path with a trailing "/" at its depth
+        normal = _clean_path(node_path)
+        n = normal.count("/")
+        return f"{self.pfx}/{n:03d}/k{normal}/".encode()
+
+    def _is_dir(self, node_path: str) -> bool:
+        if _is_root(node_path):
+            return True
+        if self._get_kv(self._dir_key(node_path)) is not None:
+            return True
+        # implicit dir: any child at depth+1 under the path
+        lo = self._mk_path(node_path + "/x", 0)  # depth+1 prefix base
+        pref = lo[: lo.rfind(b"/") + 1]
+        return bool(self.ec.range(pref, _prefix_end(pref),
+                                  limit=1)["kvs"])
+
+    # ---- reads (store.go:51-136)
+    def get(self, node_path: str, recursive: bool = False,
+            sorted_: bool = False) -> Event:
+        node_path = _clean_path(node_path)
+        rev = self._rev()
+        if not _is_root(node_path):
+            kv = self._get_kv(self._mk_path(node_path))
+            if kv is not None:
+                node = {"key": node_path, "value": kv.value.decode(),
+                        "modifiedIndex": mk_v2_rev(kv.mod_revision),
+                        "createdIndex": mk_v2_rev(kv.create_revision)}
+                return Event("get", node, etcd_index=mk_v2_rev(rev))
+            if not self._is_dir(node_path):
+                raise V2Error(EcodeKeyNotFound, node_path,
+                              mk_v2_rev(rev))
+        node = {"key": node_path, "dir": True,
+                "nodes": self._get_dir(node_path, recursive, sorted_)}
+        if not _is_root(node_path):
+            dkv = self._get_kv(self._dir_key(node_path))
+            if dkv is not None:
+                node["modifiedIndex"] = mk_v2_rev(dkv.mod_revision)
+                node["createdIndex"] = mk_v2_rev(dkv.create_revision)
+        return Event("get", node, etcd_index=mk_v2_rev(rev))
+
+    def _get_dir(self, node_path: str, recursive: bool,
+                 sorted_: bool) -> list[dict]:
+        out = self._get_dir_depth(node_path, 1)
+        if recursive:
+            # deeper levels fold under their parent dict
+            by_path = {n["key"]: n for n in out}
+            for d in range(2, MAX_DEPTH):
+                level = self._get_dir_depth(node_path, d)
+                if not level:
+                    break
+                for n in level:
+                    parent = n["key"].rsplit("/", 1)[0]
+                    p = by_path.get(parent)
+                    if p is None or "value" in p:
+                        continue  # orphan (parent hidden) — skip
+                    p.setdefault("nodes", [])
+                    p["nodes"].append(n)
+                    by_path[n["key"]] = n
+        if sorted_:
+            def walk(ns):
+                ns.sort(key=lambda n: n["key"])
+                for n in ns:
+                    if "nodes" in n:
+                        walk(n["nodes"])
+            walk(out)
+        return out
+
+    def _get_dir_depth(self, node_path: str, depth: int) -> list[dict]:
+        base = "" if _is_root(node_path) else _clean_path(node_path)
+        n = (base.count("/") if base else 0) + depth
+        pref = f"{self.pfx}/{n:03d}/k{base}/".encode()
+        kvs = self.ec.range(pref, _prefix_end(pref))["kvs"]
+        out: dict[str, dict] = {}
+        for kv in kvs:
+            s = kv.key.decode()
+            p = self._node_path(kv.key)
+            name = p.rsplit("/", 1)[-1]
+            if name.startswith("_"):
+                continue  # hidden
+            if s.endswith("/"):  # dir marker
+                out.setdefault(p, {
+                    "key": p, "dir": True,
+                    "modifiedIndex": mk_v2_rev(kv.mod_revision),
+                    "createdIndex": mk_v2_rev(kv.create_revision)})
+            else:
+                out[p] = {"key": p, "value": kv.value.decode(),
+                          "modifiedIndex": mk_v2_rev(kv.mod_revision),
+                          "createdIndex": mk_v2_rev(kv.create_revision)}
+        # implicit dirs: children one level deeper with no marker
+        n2 = n + 1
+        pref2 = f"{self.pfx}/{n2:03d}/k{base}/".encode()
+        kvs2 = self.ec.range(pref2, _prefix_end(pref2))["kvs"]
+        for kv in kvs2:
+            p = self._node_path(kv.key).rsplit("/", 1)[0]
+            name = p.rsplit("/", 1)[-1]
+            if not name.startswith("_"):
+                out.setdefault(p, {"key": p, "dir": True})
+        return list(out.values())
+
+    # ---- writes (store.go:138-265,267-352)
+    def set(self, node_path: str, dir: bool = False,
+            value: str = "") -> Event:
+        node_path = _clean_path(node_path)
+        if _is_root(node_path):
+            raise V2Error(EcodeRootROnly, "/", mk_v2_rev(self._rev()))
+        if dir:
+            return self._mkdir("set", node_path, must_create=False)
+        if self._is_dir(node_path):
+            raise V2Error(EcodeNotFile, node_path,
+                          mk_v2_rev(self._rev()))
+        key = self._mk_path(node_path)
+        prev = self._get_kv(key)
+        res = self._txn(
+            [], [Op("put", key, value.encode())] +
+            self._parent_dirs(node_path) +
+            [Op("put", self._act_key(), b"set")])
+        rev = res["rev"]
+        node = {"key": node_path, "value": value,
+                "modifiedIndex": mk_v2_rev(rev),
+                "createdIndex": mk_v2_rev(
+                    prev.create_revision if prev else rev)}
+        e = Event("set", node, etcd_index=mk_v2_rev(rev))
+        if prev is not None:
+            e.prev_node = {"key": node_path,
+                           "value": prev.value.decode(),
+                           "modifiedIndex": mk_v2_rev(prev.mod_revision),
+                           "createdIndex":
+                               mk_v2_rev(prev.create_revision)}
+        return e
+
+    def _parent_dirs(self, node_path: str) -> list[Op]:
+        # auto-create intermediate dir markers (store.go:154-160)
+        ops = []
+        parts = _clean_path(node_path).split("/")[1:-1]
+        p = ""
+        for comp in parts:
+            p += "/" + comp
+            if not self._is_dir(p):
+                ops.append(Op("put", self._dir_key(p), b""))
+        return ops
+
+    def _mkdir(self, action: str, node_path: str,
+               must_create: bool) -> Event:
+        dkey = self._dir_key(node_path)
+        if self._get_kv(self._mk_path(node_path)) is not None:
+            raise V2Error(EcodeNotDir, node_path,
+                          mk_v2_rev(self._rev()))
+        if self._get_kv(dkey) is not None:
+            if must_create:
+                raise V2Error(EcodeNodeExist, node_path,
+                              mk_v2_rev(self._rev()))
+            rev = self._rev()
+            return Event(action, {"key": node_path, "dir": True},
+                         etcd_index=mk_v2_rev(rev))
+        res = self._txn([], [Op("put", dkey, b"")] +
+                        self._parent_dirs(node_path) +
+                        [Op("put", self._act_key(), action.encode())])
+        rev = res["rev"]
+        return Event(action,
+                     {"key": node_path, "dir": True,
+                      "modifiedIndex": mk_v2_rev(rev),
+                      "createdIndex": mk_v2_rev(rev)},
+                     etcd_index=mk_v2_rev(rev))
+
+    def create(self, node_path: str, dir: bool = False, value: str = "",
+               unique: bool = False) -> Event:
+        node_path = _clean_path(node_path)
+        if unique:
+            # in-order key from the next v2 index (store.go:283-290)
+            node_path += "/" + format(mk_v2_rev(self._rev()) + 1, "020d")
+        if _is_root(node_path):
+            raise V2Error(EcodeRootROnly, "/", mk_v2_rev(self._rev()))
+        if dir:
+            return self._mkdir("create", node_path, must_create=True)
+        if self._is_dir(node_path):
+            raise V2Error(EcodeNotFile, node_path,
+                          mk_v2_rev(self._rev()))
+        key = self._mk_path(node_path)
+        res = self._txn(
+            [Compare(key, "create", "=", 0)],
+            [Op("put", key, value.encode())] +
+            self._parent_dirs(node_path) +
+            [Op("put", self._act_key(), b"create")])
+        if not res["succeeded"]:
+            raise V2Error(EcodeNodeExist, node_path,
+                          mk_v2_rev(self._rev()))
+        rev = res["rev"]
+        return Event("create",
+                     {"key": node_path, "value": value,
+                      "modifiedIndex": mk_v2_rev(rev),
+                      "createdIndex": mk_v2_rev(rev)},
+                     etcd_index=mk_v2_rev(rev))
+
+    def update(self, node_path: str, new_value: str = "") -> Event:
+        node_path = _clean_path(node_path)
+        if _is_root(node_path):
+            raise V2Error(EcodeRootROnly, "/", mk_v2_rev(self._rev()))
+        if self._is_dir(node_path):
+            raise V2Error(EcodeNotFile, node_path,
+                          mk_v2_rev(self._rev()))
+        key = self._mk_path(node_path)
+        prev = self._get_kv(key)
+        if prev is None:
+            raise V2Error(EcodeKeyNotFound, node_path,
+                          mk_v2_rev(self._rev()))
+        res = self._txn(
+            [Compare(key, "create", ">", 0)],
+            [Op("put", key, new_value.encode()),
+             Op("put", self._act_key(), b"update")])
+        if not res["succeeded"]:
+            raise V2Error(EcodeKeyNotFound, node_path,
+                          mk_v2_rev(self._rev()))
+        rev = res["rev"]
+        e = Event("update",
+                  {"key": node_path, "value": new_value,
+                   "modifiedIndex": mk_v2_rev(rev),
+                   "createdIndex": mk_v2_rev(prev.create_revision)},
+                  etcd_index=mk_v2_rev(rev))
+        e.prev_node = {"key": node_path, "value": prev.value.decode(),
+                       "modifiedIndex": mk_v2_rev(prev.mod_revision),
+                       "createdIndex": mk_v2_rev(prev.create_revision)}
+        return e
+
+    def compare_and_swap(self, node_path: str, prev_value: str,
+                         prev_index: int, value: str) -> Event:
+        node_path = _clean_path(node_path)
+        if _is_root(node_path):
+            raise V2Error(EcodeRootROnly, "/", mk_v2_rev(self._rev()))
+        if self._is_dir(node_path):
+            raise V2Error(EcodeNotFile, node_path,
+                          mk_v2_rev(self._rev()))
+        key = self._mk_path(node_path)
+        prev = self._get_kv(key)
+        if prev is None:
+            raise V2Error(EcodeKeyNotFound, node_path,
+                          mk_v2_rev(self._rev()))
+        cmps = [Compare(key, "create", ">", 0)]
+        if prev_value:
+            cmps.append(Compare(key, "value", "=",
+                                prev_value.encode()))
+        if prev_index:
+            cmps.append(Compare(key, "mod", "=",
+                                mk_v3_rev(prev_index)))
+        res = self._txn(cmps, [
+            Op("put", key, value.encode()),
+            Op("put", self._act_key(), b"compareAndSwap")])
+        if not res["succeeded"]:
+            raise V2Error(
+                EcodeTestFailed,
+                f"[{prev_value} != {prev.value.decode()}]"
+                if prev_value else
+                f"[{prev_index} != {mk_v2_rev(prev.mod_revision)}]",
+                mk_v2_rev(self._rev()))
+        rev = res["rev"]
+        e = Event("compareAndSwap",
+                  {"key": node_path, "value": value,
+                   "modifiedIndex": mk_v2_rev(rev),
+                   "createdIndex": mk_v2_rev(prev.create_revision)},
+                  etcd_index=mk_v2_rev(rev))
+        e.prev_node = {"key": node_path, "value": prev.value.decode(),
+                       "modifiedIndex": mk_v2_rev(prev.mod_revision),
+                       "createdIndex": mk_v2_rev(prev.create_revision)}
+        return e
+
+    def compare_and_delete(self, node_path: str, prev_value: str,
+                           prev_index: int) -> Event:
+        node_path = _clean_path(node_path)
+        if self._is_dir(node_path):
+            raise V2Error(EcodeNotFile, node_path,
+                          mk_v2_rev(self._rev()))
+        key = self._mk_path(node_path)
+        prev = self._get_kv(key)
+        if prev is None:
+            raise V2Error(EcodeKeyNotFound, node_path,
+                          mk_v2_rev(self._rev()))
+        cmps = [Compare(key, "create", ">", 0)]
+        if prev_value:
+            cmps.append(Compare(key, "value", "=", prev_value.encode()))
+        if prev_index:
+            cmps.append(Compare(key, "mod", "=", mk_v3_rev(prev_index)))
+        res = self._txn(cmps, [
+            Op("delete", key),
+            Op("put", self._act_key(), b"compareAndDelete")])
+        if not res["succeeded"]:
+            raise V2Error(
+                EcodeTestFailed,
+                f"[{prev_value} != {prev.value.decode()}]"
+                if prev_value else
+                f"[{prev_index} != {mk_v2_rev(prev.mod_revision)}]",
+                mk_v2_rev(self._rev()))
+        rev = res["rev"]
+        e = Event("compareAndDelete",
+                  {"key": node_path,
+                   "modifiedIndex": mk_v2_rev(rev),
+                   "createdIndex": mk_v2_rev(prev.create_revision)},
+                  etcd_index=mk_v2_rev(rev))
+        e.prev_node = {"key": node_path, "value": prev.value.decode(),
+                       "modifiedIndex": mk_v2_rev(prev.mod_revision),
+                       "createdIndex": mk_v2_rev(prev.create_revision)}
+        return e
+
+    def delete(self, node_path: str, dir: bool = False,
+               recursive: bool = False) -> Event:
+        node_path = _clean_path(node_path)
+        if _is_root(node_path):
+            raise V2Error(EcodeRootROnly, "/", mk_v2_rev(self._rev()))
+        if recursive:
+            dir = True
+        if self._is_dir(node_path):
+            if not dir:
+                raise V2Error(EcodeNotFile, node_path,
+                              mk_v2_rev(self._rev()))
+            children = self._get_dir_depth(node_path, 1)
+            if children and not recursive:
+                raise V2Error(EcodeDirNotEmpty, node_path,
+                              mk_v2_rev(self._rev()))
+            ops = [Op("delete", self._dir_key(node_path))]
+            base = _clean_path(node_path)
+            for d in range(1, MAX_DEPTH):
+                n = base.count("/") + d
+                pref = f"{self.pfx}/{n:03d}/k{base}/".encode()
+                kvs = self.ec.range(pref, _prefix_end(pref))["kvs"]
+                if not kvs:
+                    break
+                ops.append(Op("delete", pref, range_end=_prefix_end(pref)))
+            ops.append(Op("put", self._act_key(), b"delete"))
+            res = self._txn([], ops)
+            rev = res["rev"]
+            return Event("delete",
+                         {"key": node_path, "dir": True,
+                          "modifiedIndex": mk_v2_rev(rev)},
+                         etcd_index=mk_v2_rev(rev))
+        key = self._mk_path(node_path)
+        prev = self._get_kv(key)
+        if prev is None:
+            raise V2Error(EcodeKeyNotFound, node_path,
+                          mk_v2_rev(self._rev()))
+        res = self._txn([], [Op("delete", key),
+                             Op("put", self._act_key(), b"delete")])
+        rev = res["rev"]
+        e = Event("delete",
+                  {"key": node_path, "modifiedIndex": mk_v2_rev(rev),
+                   "createdIndex": mk_v2_rev(prev.create_revision)},
+                  etcd_index=mk_v2_rev(rev))
+        e.prev_node = {"key": node_path, "value": prev.value.decode(),
+                       "modifiedIndex": mk_v2_rev(prev.mod_revision),
+                       "createdIndex": mk_v2_rev(prev.create_revision)}
+        return e
+
+    # ---- watch (watcher.go): a v3 watch over the key plane; the action
+    # key written in the same txn recovers the v2 action per revision
+    def watch(self, node_path: str, recursive: bool = False,
+              since_index: int = 0) -> "V2v3Watcher":
+        return V2v3Watcher(self, node_path, recursive, since_index)
+
+
+class V2v3Watcher:
+    def __init__(self, store: V2v3Store, node_path: str,
+                 recursive: bool, since_index: int):
+        self.store = store
+        self.path = _clean_path(node_path)
+        self.recursive = recursive
+        ec = store.ec
+        m = ec.ensure_leader()
+        self.member = m
+        pref = store.pfx.encode()
+        start = mk_v3_rev(since_index) if since_index else 0
+        self.watch_id = ec.watch(
+            m, pref, _prefix_end(pref), start_rev=start, prev_kv=True).id
+
+    def next(self) -> Event | None:
+        ec = self.store.ec
+        evs = ec.watch_events(self.member, self.watch_id)
+        # group by mod_revision; find the action key + the node key
+        act_key = self.store._act_key()
+        by_rev: dict[int, dict] = {}
+        for ev in evs:
+            kv = ev.kv
+            rev = kv.mod_revision
+            g = by_rev.setdefault(rev, {"action": None, "kvs": []})
+            if kv.key == act_key:
+                g["action"] = kv.value.decode()
+            elif b"/k" in kv.key:
+                g["kvs"].append((ev.type, kv, ev.prev_kv))
+        for rev in sorted(by_rev):
+            g = by_rev[rev]
+            for typ, kv, prev in g["kvs"]:
+                s = kv.key.decode()
+                if s.endswith("/"):
+                    continue  # dir markers don't fire v2 watch events
+                p = self.store._node_path(kv.key)
+                interested = (p == self.path or
+                              (self.recursive and
+                               p.startswith(self.path.rstrip("/") + "/")))
+                if not interested:
+                    continue
+                action = g["action"] or \
+                    ("delete" if typ == "delete" else "set")
+                node: dict[str, Any] = {
+                    "key": p, "modifiedIndex": mk_v2_rev(rev)}
+                if typ != "delete":
+                    node["value"] = kv.value.decode()
+                    node["createdIndex"] = mk_v2_rev(kv.create_revision)
+                e = Event(action, node, etcd_index=mk_v2_rev(rev))
+                if prev is not None:
+                    e.prev_node = {
+                        "key": p, "value": prev.value.decode(),
+                        "modifiedIndex": mk_v2_rev(prev.mod_revision),
+                        "createdIndex": mk_v2_rev(prev.create_revision)}
+                return e
+        return None
+
+    def remove(self) -> None:
+        self.store.ec.cancel_watch(self.member, self.watch_id)
+
+
+def _prefix_end(prefix: bytes) -> bytes:
+    end = bytearray(prefix)
+    for i in range(len(end) - 1, -1, -1):
+        if end[i] < 0xFF:
+            end[i] += 1
+            return bytes(end[: i + 1])
+    return b"\x00"

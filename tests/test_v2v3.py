@@ -1,0 +1,175 @@
+"""v2 API emulated on the v3 MVCC store (api/v2v3 analog): depth-encoded
+keys, dir markers, txn-guarded CAS/CAD, action-key watch recovery."""
+import pytest
+
+from etcd_tpu.server.kvserver import EtcdCluster
+from etcd_tpu.server.v2store import (
+    EcodeDirNotEmpty,
+    EcodeKeyNotFound,
+    EcodeNodeExist,
+    EcodeNotFile,
+    EcodeTestFailed,
+    V2Error,
+)
+from etcd_tpu.server.v2v3 import V2v3Store, mk_v2_rev, mk_v3_rev
+
+
+@pytest.fixture(scope="module")
+def ec():
+    c = EtcdCluster(n_members=3)
+    c.ensure_leader()
+    return c
+
+
+@pytest.fixture()
+def s(ec):
+    st = V2v3Store(ec, pfx="/__v2")
+    # fresh namespace per test: drop everything under the prefix
+    try:
+        st.delete("/t", recursive=True)
+    except V2Error:
+        pass
+    return st
+
+
+def test_rev_mapping():
+    assert mk_v2_rev(0) == 0 and mk_v2_rev(5) == 4
+    assert mk_v3_rev(0) == 0 and mk_v3_rev(4) == 5
+
+
+def test_set_get_roundtrip(s):
+    e = s.set("/t/foo", value="bar")
+    assert e.action == "set"
+    g = s.get("/t/foo")
+    assert g.node["value"] == "bar"
+    assert g.node["createdIndex"] > 0
+    # replace keeps v2 semantics: new mod index, prevNode reported
+    e2 = s.set("/t/foo", value="baz")
+    assert e2.prev_node["value"] == "bar"
+    assert e2.node["modifiedIndex"] > e.node["modifiedIndex"]
+
+
+def test_get_missing(s):
+    with pytest.raises(V2Error) as ei:
+        s.get("/t/nope")
+    assert ei.value.code == EcodeKeyNotFound
+
+
+def test_create_semantics(s):
+    e = s.create("/t/c", value="v1")
+    assert e.action == "create"
+    with pytest.raises(V2Error) as ei:
+        s.create("/t/c", value="v2")
+    assert ei.value.code == EcodeNodeExist
+
+
+def test_update_requires_existing(s):
+    with pytest.raises(V2Error) as ei:
+        s.update("/t/u", "v")
+    assert ei.value.code == EcodeKeyNotFound
+    s.set("/t/u", value="v1")
+    e = s.update("/t/u", "v2")
+    assert e.action == "update"
+    assert e.prev_node["value"] == "v1"
+    assert e.node["createdIndex"] == e.prev_node["createdIndex"]
+
+
+def test_cas_cad(s):
+    s.set("/t/k", value="v1")
+    with pytest.raises(V2Error) as ei:
+        s.compare_and_swap("/t/k", "bad", 0, "v2")
+    assert ei.value.code == EcodeTestFailed
+    e = s.compare_and_swap("/t/k", "v1", 0, "v2")
+    assert e.action == "compareAndSwap"
+    idx = e.node["modifiedIndex"]
+    e = s.compare_and_swap("/t/k", "", idx, "v3")
+    assert e.node["value"] == "v3"
+    with pytest.raises(V2Error):
+        s.compare_and_delete("/t/k", "wrong", 0)
+    e = s.compare_and_delete("/t/k", "v3", 0)
+    assert e.action == "compareAndDelete"
+    with pytest.raises(V2Error):
+        s.get("/t/k")
+
+
+def test_dirs_implicit_and_markers(s):
+    s.set("/t/d/a", value="1")
+    s.set("/t/d/b", value="2")
+    g = s.get("/t/d", sorted_=True)
+    assert g.node["dir"] is True
+    assert [n["value"] for n in g.node["nodes"]] == ["1", "2"]
+    # explicit empty dir via marker
+    s.create("/t/empty", dir=True)
+    g = s.get("/t/empty")
+    assert g.node["dir"] is True and g.node["nodes"] == []
+    # a dir is not a file
+    with pytest.raises(V2Error) as ei:
+        s.set("/t/d", value="x")
+    assert ei.value.code == EcodeNotFile
+
+
+def test_recursive_listing(s):
+    s.set("/t/r/x", value="1")
+    s.set("/t/r/sub/y", value="2")
+    g = s.get("/t/r", recursive=True, sorted_=True)
+    keys = [n["key"] for n in g.node["nodes"]]
+    assert keys == ["/t/r/sub", "/t/r/x"]
+    sub = g.node["nodes"][0]
+    assert sub["nodes"][0]["key"] == "/t/r/sub/y"
+    # non-recursive shows the sub dir without children
+    g = s.get("/t/r", sorted_=True)
+    assert "nodes" not in g.node["nodes"][0] or \
+        not g.node["nodes"][0].get("nodes")
+
+
+def test_delete_dir_rules(s):
+    s.set("/t/dd/k", value="v")
+    with pytest.raises(V2Error) as ei:
+        s.delete("/t/dd")
+    assert ei.value.code == EcodeNotFile
+    with pytest.raises(V2Error) as ei:
+        s.delete("/t/dd", dir=True)
+    assert ei.value.code == EcodeDirNotEmpty
+    e = s.delete("/t/dd", recursive=True)
+    assert e.node["dir"] is True
+    with pytest.raises(V2Error):
+        s.get("/t/dd/k")
+
+
+def test_create_in_order(s):
+    e1 = s.create("/t/q", unique=True, value="a")
+    e2 = s.create("/t/q", unique=True, value="b")
+    assert e1.node["key"] < e2.node["key"]
+    g = s.get("/t/q", sorted_=True)
+    assert [n["value"] for n in g.node["nodes"]] == ["a", "b"]
+
+
+def test_hidden_nodes_skipped(s):
+    s.set("/t/h/_secret", value="x")
+    s.set("/t/h/vis", value="y")
+    g = s.get("/t/h", sorted_=True)
+    assert [n["key"] for n in g.node["nodes"]] == ["/t/h/vis"]
+
+
+def test_watch_action_recovery(s):
+    w = s.watch("/t/w", recursive=True)
+    s.set("/t/w/a", value="1")
+    ev = w.next()
+    assert ev is not None
+    assert ev.action == "set"
+    assert ev.node["key"] == "/t/w/a"
+    s.compare_and_swap("/t/w/a", "1", 0, "2")
+    ev = w.next()
+    assert ev.action == "compareAndSwap"
+    assert ev.prev_node["value"] == "1"
+    s.delete("/t/w/a")
+    ev = w.next()
+    assert ev.action == "delete"
+    w.remove()
+
+
+def test_v2v3_state_is_replicated(ec, s):
+    s.set("/t/rep", value="v")
+    ec.stabilize()
+    hashes = {ec.hash_kv(m) for m in range(3)}
+    assert len(hashes) == 1  # same v3 store everywhere
