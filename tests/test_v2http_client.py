@@ -300,3 +300,63 @@ def test_etcdctl_v2_commands(etcd, capsys):
     # error path: rm of a missing key exits 1 with the v2 error line
     assert etcdctl.main([*ep, "rm", "/ctl/nope"]) == 1
     assert "100" in capsys.readouterr().err
+
+
+def test_v2_ttl_over_http(api):
+    """TTL params through the façade: ttl sets expiration/ttl on the
+    node; refresh keeps the value while renewing the TTL; SYNC expiry
+    removes the key (client.go TTL handling end-to-end)."""
+    ec = api.ec
+
+    class Clk:
+        t = 5000.0
+
+        def __call__(self):
+            return Clk.t
+
+    clk = Clk()
+    old_now = ec.v2_now
+    ec.v2_now = clk
+    for ms in ec.members:
+        ms.v2store.clock = clk
+    try:
+        st, body, _ = api.keys("PUT", "/ttlh/a",
+                               {"value": "v", "ttl": "30"})
+        assert st == 201
+        assert body["node"]["ttl"] == 30
+        assert "expiration" in body["node"]
+        # refresh: no value, renew ttl, no watch event content change
+        st, body, _ = api.keys(
+            "PUT", "/ttlh/a",
+            {"ttl": "60", "refresh": "true", "prevExist": "true"})
+        assert st == 200
+        assert body["node"]["value"] == "v"  # kept by refresh
+        assert body["node"]["ttl"] == 60
+        # expire via the replicated SYNC cutoff
+        Clk.t += 120
+        ec.v2_sync()
+        st, body, _ = api.keys("GET", "/ttlh/a", {})
+        assert st == 404
+    finally:
+        ec.v2_now = old_now
+
+
+def test_etcdctl_v2_set_with_ttl(etcd, capsys):
+    from etcd_tpu import etcdctl
+
+    ep = ["--endpoint", etcd.client_url, "v2"]
+    assert etcdctl.main([*ep, "set", "/ttlctl/k", "v", "--ttl",
+                         "3600"]) == 0
+    capsys.readouterr()
+    assert etcdctl.main([*ep, "get", "/ttlctl/k"]) == 0
+    assert capsys.readouterr().out.strip().endswith("v")
+
+
+def test_v2_quorum_get_from_follower(api):
+    """QGET routed through a follower still serves the committed value
+    (the proposal forwards through consensus)."""
+    api.keys("PUT", "/qf/a", {"value": "x"})
+    follower = next(m for m in range(3)
+                    if m != api.ec.ensure_leader())
+    ev = api.ec.v2_request("QGET", "/qf/a", member=follower)
+    assert ev.node["value"] == "x"
