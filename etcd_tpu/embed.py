@@ -192,6 +192,12 @@ class Etcd:
         owed = 0.0
         ticks = 0
         mon_every = self.config.monitor_version_ticks
+        # v2 TTL expiry is driven by committed SYNC proposals (the
+        # reference's syncer fires every 500ms, etcdserver/server.go);
+        # without this, expired v2 keys stay visible forever on a
+        # running server
+        sync_every = max(1, round(0.5 / period))
+        sync_failed = False
         while not self._stop.wait(period):
             owed += period
             advance = int(owed)
@@ -210,6 +216,28 @@ class Etcd:
                 for _ in range(advance - 1):  # tick_ms > 1000: catch up
                     self.server.advance_lease_clock()
                 self.compactor.tick()
+                if ticks % sync_every == 0:
+                    from etcd_tpu.server.kvserver import ServerError
+                    from etcd_tpu.types import NONE_ID
+                    from etcd_tpu.utils.logging import get_logger
+
+                    try:
+                        # leader() is a pure probe: ensure_leader()'s
+                        # forced ticks would fast-forward the lease
+                        # clock during leaderless windows
+                        lead = self.server.leader()
+                        if lead != NONE_ID and self.server.members[lead] \
+                                .v2store.has_ttl_keys():
+                            self.server.v2_sync()
+                        sync_failed = False
+                    except ServerError as e:
+                        # lost leadership / backpressure mid-pass; the
+                        # next pass retries — but say so once per streak
+                        # (silent failure here means TTLs never expire)
+                        if not sync_failed:
+                            get_logger().warning(
+                                "v2 SYNC proposal failed: %s", e)
+                        sync_failed = True
                 if mon_every and ticks % mon_every == 0:
                     # monitorVersions + monitorDowngrade passes (leader
                     # only; no-ops otherwise). Proposal failures (lost

@@ -16,11 +16,13 @@ like the v3 façade's JSON long-poll stands in for gRPC streams.
 """
 from __future__ import annotations
 
+import time
 from typing import Any
 
 from etcd_tpu.models.changer import ConfChangeError
 from etcd_tpu.server.kvserver import EtcdCluster, ServerError
 from etcd_tpu.server.v2store import (
+    _clean_path,
     EcodeIndexNaN,
     EcodeInvalidField,
     EcodePrevValueRequired,
@@ -29,6 +31,7 @@ from etcd_tpu.server.v2store import (
     EcodeRefreshValue,
     EcodeTTLNaN,
     EcodeUnauthorized,
+    EcodeWatcherCleared,
     Event,
     V2Error,
 )
@@ -120,12 +123,23 @@ class V2Api:
     """keysHandler + membersHandler + statsHandler + the v2auth admin
     surface (client_auth.go) over EtcdCluster."""
 
+    # Parked long-poll watchers that the client never polls again would
+    # otherwise leak until their 100-event overflow: evict after
+    # PARK_TTL seconds without a poll, and bound the registry size.
+    # The TTL scan itself is throttled to SWEEP_EVERY (it is on the
+    # long-poll hot path); the cap check runs every time.
+    PARK_TTL = 300.0
+    PARK_CAP = 1024
+    SWEEP_EVERY = 1.0
+
     def __init__(self, ec: EtcdCluster):
         from etcd_tpu.server.v2auth import V2AuthStore
 
         self.ec = ec
         self.auth = V2AuthStore(ec)
         self._watches: dict[int, Any] = {}
+        self._watch_seen: dict[int, float] = {}
+        self._last_sweep = 0.0
         self._next_watch = 1
 
     @staticmethod
@@ -143,6 +157,13 @@ class V2Api:
         from etcd_tpu.server.v2auth import AuthError
 
         form = form or {}
+        # Canonicalize BEFORE the auth guard: the store cleans the path
+        # at apply time, so guarding the raw string would let
+        # //_security/... or /a/../_security/... slip past both the
+        # /_security prefix check and pattern matching (the reference
+        # gets this from Go's mux canonicalization + path.Join before
+        # any store access).
+        key = _clean_path(key)
         try:
             r = parse_key_request(method, form)
             # the basic-auth guard (client_auth.go hasKeyPrefixAccess)
@@ -211,28 +232,86 @@ class V2Api:
         if ev is not None and not r["stream"]:
             w.remove()
             return 200, ev.to_json(), self._headers()
+        self._evict_stale_watches(reserve=1)
         wid = self._next_watch
         self._next_watch += 1
         self._watches[wid] = w
+        self._watch_seen[wid] = time.monotonic()
         out: dict[str, Any] = {"watch_id": wid}
         if ev is not None:  # stream watcher with a ready history event
             out["event"] = ev.to_json()
         return 200, out, self._headers()
 
+    def _evict_stale_watches(self, reserve: int = 0) -> None:
+        """`reserve` slots are held back for an imminent registration;
+        plain polls pass 0 so a registry sitting exactly at PARK_CAP is
+        not trimmed by unrelated traffic."""
+        now = time.monotonic()
+        if now - self._last_sweep >= self.SWEEP_EVERY:
+            self._last_sweep = now
+            for wid, t in list(self._watch_seen.items()):
+                if now - t <= self.PARK_TTL:
+                    continue
+                w = self._watches.get(wid)
+                if w is None or w.cleared:
+                    # poisoned tombstone outlived its grace window
+                    # unclaimed: drop it for good
+                    self.watch_cancel(wid)
+                else:
+                    # free the store-side watcher now, but keep a
+                    # poisoned tombstone for one more TTL window so a
+                    # returning client gets EcodeWatcherCleared (the
+                    # re-watch signal) instead of a bare miss
+                    w.cleared = True
+                    w.remove()
+                    self._watch_seen[wid] = now
+        # over cap even after the TTL pass: shed dead tombstones first,
+        # then oldest live watches
+        excess = len(self._watches) - (self.PARK_CAP - reserve)
+        if excess > 0:
+            order = sorted(
+                self._watch_seen,
+                key=lambda i: (not self._watches[i].cleared,
+                               self._watch_seen[i]))
+            for wid in order[:excess]:
+                self.watch_cancel(wid)
+
     def watch_poll(self, watch_id: int) -> tuple[int, dict, dict]:
         w = self._watches.get(watch_id)
+        if w is not None:
+            # refresh BEFORE the sweep so a poll always keeps its own
+            # watch alive, even arriving just past PARK_TTL
+            self._watch_seen[watch_id] = time.monotonic()
+        self._evict_stale_watches()
         if w is None:
-            return 404, {"error": "unknown watch"}, self._headers()
-        ev = w.poll()
+            # cap-shed, cancelled, or tombstone expired: same 400 +
+            # cleared errorCode as the poisoned path, so every "this
+            # watch is gone, re-watch" condition looks identical
+            err = V2Error(EcodeWatcherCleared, "unknown or evicted watch",
+                          self._store().current_index)
+            return err.status_code(), err.to_json(), self._headers()
+        try:
+            ev = w.poll()
+        except V2Error as e:
+            # EcodeWatcherCleared after recovery/overflow/eviction:
+            # surface the error once with the current store index (the
+            # v2 re-watch recipe is waitIndex=index+1), then forget the
+            # watch (store.go WatcherHub clear semantics)
+            if not e.index:
+                e.index = self._store().current_index
+            self.watch_cancel(watch_id)
+            return e.status_code(), e.to_json(), self._headers()
         if ev is None:
             return 200, {}, self._headers()
         if not w.stream:
             w.remove()
             del self._watches[watch_id]
+            self._watch_seen.pop(watch_id, None)
         return 200, {"event": ev.to_json()}, self._headers()
 
     def watch_cancel(self, watch_id: int) -> None:
         w = self._watches.pop(watch_id, None)
+        self._watch_seen.pop(watch_id, None)
         if w is not None:
             w.remove()
 

@@ -459,13 +459,19 @@ class Watcher:
         self.start_index = start_index  # EtcdIndex at creation
         self.events: deque[Event] = deque()
         self.removed = False
+        self.cleared = False  # poisoned by recovery(); next poll errors
 
     def notify(self, e: Event, original_path: bool, deleted: bool) -> bool:
         # watcher.go:43-75 interest predicate
         if (self.recursive or original_path or deleted) \
                 and e.index() >= self.since_index:
             if len(self.events) >= self.CAPACITY:
-                self.remove()  # missed a notification: drop the watcher
+                # missed a notification: drop the watcher, and poison it
+                # so a client still polling gets EcodeWatcherCleared once
+                # the buffer drains instead of silent empty polls forever
+                # (the reference closes the event channel here)
+                self.cleared = True
+                self.remove()
                 return True
             self.events.append(e)
             return True
@@ -473,7 +479,14 @@ class Watcher:
 
     def poll(self) -> Event | None:
         """Drain one event (the gateway's long-poll read)."""
-        return self.events.popleft() if self.events else None
+        if self.events:
+            return self.events.popleft()
+        if self.cleared:
+            # store.go WatcherHub.clone/recovery drops the hub; clients
+            # get EcodeWatcherCleared so they know to re-watch
+            raise V2Error(EcodeWatcherCleared,
+                          "the watcher is cleared on store recovery")
+        return None
 
     def remove(self) -> None:
         if not self.removed:
@@ -482,13 +495,13 @@ class Watcher:
 
 
 def _is_hidden(watch_path: str, key_path: str) -> bool:
-    """watcher_hub.go isHidden: the first component of keyPath below
-    watchPath starts with '_' (hidden subtree not visible to watchers
-    above it)."""
+    """watcher_hub.go isHidden: ANY component of keyPath below watchPath
+    starting with '_' hides the event (watching /a recursively must not
+    see /a/b/_h, not just /a/_h)."""
     if len(watch_path) > len(key_path):
         return False
     after = key_path[len(watch_path):].lstrip("/")
-    return after.startswith("_")
+    return any(seg.startswith("_") for seg in after.split("/") if seg)
 
 
 class WatcherHub:
@@ -900,6 +913,13 @@ class V2Store:
         self.root = Node.from_save(self, d["root"], None)
         self._ttl_heap = []
         self._ttl_seq = 0
+        # Poison live watchers before discarding the hub: their next
+        # poll raises EcodeWatcherCleared (the reference's recovery
+        # path returns 400 so clients know to re-watch) instead of
+        # silently never firing again.
+        for ws in self.hub.watchers.values():
+            for w in list(ws):
+                w.cleared = True
         self.hub = WatcherHub(self.hub.history.capacity)
         self._rebuild_ttl(self.root)
 

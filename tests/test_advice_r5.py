@@ -1,0 +1,240 @@
+"""Regression tests for the round-4 advisor findings: v2 façade path
+canonicalization before the auth guard, tick-loop-driven v2 SYNC expiry,
+nested hidden-node watch suppression, parked-watch eviction, and
+EcodeWatcherCleared on store recovery."""
+import time
+
+import pytest
+
+from etcd_tpu.server.kvserver import EtcdCluster
+from etcd_tpu.server.v2http import V2Api
+from etcd_tpu.server.v2store import (
+    EcodeKeyNotFound,
+    EcodeUnauthorized,
+    EcodeWatcherCleared,
+    V2Error,
+    V2Store,
+    _is_hidden,
+)
+
+
+@pytest.fixture(scope="module")
+def ec():
+    c = EtcdCluster(n_members=3)
+    c.ensure_leader()
+    return c
+
+
+@pytest.fixture()
+def api(ec):
+    return V2Api(ec)
+
+
+# ------------------------------------------- high: path canonicalization
+
+def test_security_subtree_unreachable_via_raw_paths(ec):
+    """//_security/... and /a/../_security/... must hit the same guard
+    as /_security/... (the store cleans paths at apply time, so the
+    façade must clean them before the auth check too —
+    v2http/client.go relies on Go's mux canonicalization)."""
+    api = V2Api(ec)
+    root = {"_basic_auth": "root:rpw"}
+    api.auth_admin("PUT", "/users/root", {**root, "password": "rpw"})
+    api.auth_admin("PUT", "/enable", root)
+    try:
+        for evil in ("//_security/users/mallory",
+                     "/a/../_security/users/mallory",
+                     "/ok/./../_security/enabled"):
+            st, body, _ = api.keys("PUT", evil, {"value": "pwn"})
+            assert st == 403, evil
+            assert body["errorCode"] == EcodeUnauthorized, evil
+            st, body, _ = api.keys("GET", evil, {})
+            assert st == 403, evil
+        # and the canonical form still guards (sanity)
+        st, body, _ = api.keys("GET", "/_security/enabled", {})
+        assert st == 403
+        # permission matching also sees the cleaned path: a non-root
+        # user scoped to /app/* may write //app/x (same key)
+        api.auth_admin("PUT", "/roles/writer", {
+            **root,
+            "permissions": {"kv": {"read": ["/app/*"],
+                                   "write": ["/app/*"]}}})
+        api.auth_admin("PUT", "/users/bob",
+                       {**root, "password": "bpw", "roles": "writer"})
+        st, body, _ = api.keys(
+            "PUT", "//app/x", {"value": "v", "_basic_auth": "bob:bpw"})
+        assert st == 201 and body["node"]["key"] == "/app/x"
+        # ...but not escape its scope via dot-dot
+        st, body, _ = api.keys(
+            "PUT", "/app/../other", {"value": "v",
+                                     "_basic_auth": "bob:bpw"})
+        assert st == 401
+    finally:
+        api.auth_admin("DELETE", "/enable", root)
+
+
+# ----------------------------------------- medium: tick-loop v2 SYNC
+
+def test_tick_loop_proposes_v2_sync(tmp_path):
+    """A TTL key on a *running* server expires without any client
+    calling sync: embed's ticker proposes SYNC every ~500ms
+    (etcdserver's syncer cadence)."""
+    from etcd_tpu.embed import Config, start_etcd
+
+    e = start_etcd(Config(cluster_size=1, data_dir=str(tmp_path / "d"),
+                          tick_ms=50, auto_tick=True))
+    try:
+        st, body, _ = e.http.v2api.keys(
+            "PUT", "/ttl/auto", {"value": "v", "ttl": "1"})
+        assert st == 201 and body["node"]["ttl"] == 1
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            st, body, _ = e.http.v2api.keys("GET", "/ttl/auto", {})
+            if st == 404:
+                break
+            time.sleep(0.2)
+        assert st == 404
+        assert body["errorCode"] == EcodeKeyNotFound
+    finally:
+        e.close()
+
+
+# ------------------------------------------- low: nested hidden nodes
+
+def test_is_hidden_nested_components():
+    assert _is_hidden("/a", "/a/_h")
+    assert _is_hidden("/a", "/a/b/_h")         # the nested case
+    assert _is_hidden("/", "/x/_deep/leaf")
+    assert not _is_hidden("/a", "/a/b/c")
+    # components *inside* the watch path don't hide (watching under a
+    # hidden dir sees its own events — watcher_hub.go passes afterPath)
+    assert not _is_hidden("/_h/sub", "/_h/sub/leaf")
+
+
+def test_watcher_suppresses_nested_hidden_events():
+    s = V2Store()
+    w = s.watch("/a", recursive=True, stream=True)
+    s.create("/a/b/_h", value="secret")
+    assert w.poll() is None
+    s.create("/a/b/c", value="visible")
+    ev = w.poll()
+    assert ev is not None and ev.node["key"] == "/a/b/c"
+
+
+# ------------------------------------------- low: parked-watch eviction
+
+def test_parked_watch_ttl_eviction(ec):
+    api = V2Api(ec)
+    st, body, _ = api.keys("GET", "/pw/none", {"wait": "true"})
+    wid = body["watch_id"]
+    assert wid in api._watches
+    # a poll refreshes the clock; an idle park past PARK_TTL is poisoned
+    api.watch_poll(wid)
+    api._watch_seen[wid] -= V2Api.PARK_TTL + 1
+    api._last_sweep = 0.0  # the sweep itself is throttled to 1/s
+    api.keys("GET", "/pw/other", {"wait": "true"})  # triggers sweep
+    w = api._watches[wid]
+    assert w.cleared and w.removed  # store-side watcher freed
+    # a returning client gets the re-watch signal once, with the index
+    st, body, _ = api.watch_poll(wid)
+    assert st == 400 and body["errorCode"] == EcodeWatcherCleared
+    assert body["index"] > 0
+    # ...and a bare miss afterwards looks identical (400 + errorCode)
+    # so clientv2 raises instead of treating it as an empty poll
+    st, body, _ = api.watch_poll(wid)
+    assert st == 400 and body["errorCode"] == EcodeWatcherCleared
+    # an unclaimed tombstone is dropped after a second TTL window
+    st, body, _ = api.keys("GET", "/pw/third", {"wait": "true"})
+    wid2 = body["watch_id"]
+    api._watch_seen[wid2] -= 2 * (V2Api.PARK_TTL + 1)
+    api._watches[wid2].cleared = True
+    api._last_sweep = 0.0
+    api.keys("GET", "/pw/fourth", {"wait": "true"})
+    assert wid2 not in api._watches
+
+
+def test_poll_keeps_own_watch_alive_and_sheds_tombstones_first(ec):
+    """A poll arriving just past PARK_TTL refreshes its own watch before
+    the sweep; cap pressure drops dead tombstones before live parks."""
+    api = V2Api(ec)
+    _, body, _ = api.keys("GET", "/ka/x", {"wait": "true"})
+    wid = body["watch_id"]
+    api._watch_seen[wid] -= V2Api.PARK_TTL + 1
+    api._last_sweep = 0.0
+    st, body, _ = api.watch_poll(wid)  # the late poll itself
+    assert st == 200 and body == {}  # still alive, not poisoned
+    assert not api._watches[wid].cleared
+    # tombstones shed before live watches under cap pressure
+    _, b2, _ = api.keys("GET", "/ka/y", {"wait": "true"})
+    api._watches[b2["watch_id"]].cleared = True  # dead tombstone
+    old_cap = V2Api.PARK_CAP
+    V2Api.PARK_CAP = len(api._watches)
+    try:
+        _, b3, _ = api.keys("GET", "/ka/z", {"wait": "true"})
+    finally:
+        V2Api.PARK_CAP = old_cap
+    assert b2["watch_id"] not in api._watches  # tombstone went first
+    assert wid in api._watches  # live watch survived
+
+
+def test_parked_watch_cap(ec, monkeypatch):
+    monkeypatch.setattr(V2Api, "PARK_CAP", 4)
+    api = V2Api(ec)
+    wids = []
+    for i in range(6):
+        _, body, _ = api.keys("GET", f"/cap/{i}", {"wait": "true"})
+        wids.append(body["watch_id"])
+    assert len(api._watches) <= 4
+    assert wids[0] not in api._watches  # oldest shed first
+    assert wids[-1] in api._watches
+
+
+# ------------------------------------- low: EcodeWatcherCleared on recovery
+
+def test_recovery_poisons_store_watchers():
+    s = V2Store()
+    s.create("/r/a", value="1")
+    w = s.watch("/r", recursive=True, stream=True)
+    s.recovery(s.save())
+    with pytest.raises(V2Error) as ei:
+        w.poll()
+    assert ei.value.code == EcodeWatcherCleared
+    # the fresh hub serves new watchers normally
+    w2 = s.watch("/r", recursive=True, stream=True)
+    s.create("/r/b", value="2")
+    assert w2.poll().node["key"] == "/r/b"
+
+
+def test_overflowed_watcher_poisoned_not_silent():
+    """A stream watcher that misses a notification (100-event overflow)
+    raises EcodeWatcherCleared after draining, instead of returning
+    empty polls forever (the reference closes the event channel)."""
+    s = V2Store()
+    w = s.watch("/of", recursive=True, stream=True)
+    from etcd_tpu.server.v2store import Watcher
+
+    for i in range(Watcher.CAPACITY + 1):
+        s.set(f"/of/{i}", value=str(i))
+    drained = 0
+    while w.events:
+        assert w.poll() is not None
+        drained += 1
+    assert drained == Watcher.CAPACITY
+    with pytest.raises(V2Error) as ei:
+        w.poll()
+    assert ei.value.code == EcodeWatcherCleared
+
+
+def test_facade_watch_poll_reports_cleared(ec):
+    api = V2Api(ec)
+    _, body, _ = api.keys("GET", "/rc/none", {"wait": "true"})
+    wid = body["watch_id"]
+    store = api._store()
+    store.recovery(store.save())
+    st, body, _ = api.watch_poll(wid)
+    assert st == 400 and body["errorCode"] == EcodeWatcherCleared
+    assert body["index"] > 0
+    # the façade forgets the watch after surfacing the error once; the
+    # miss looks identical (400 + cleared errorCode)
+    st, body, _ = api.watch_poll(wid)
+    assert st == 400 and body["errorCode"] == EcodeWatcherCleared

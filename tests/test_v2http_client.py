@@ -110,8 +110,10 @@ def test_watch_longpoll_registry(api):
     api.keys("PUT", "/wlp", {"value": "v"})
     status, body, _ = api.watch_poll(wid)
     assert body["event"]["action"] == "set"
-    # one-shot: consumed and deregistered
-    assert api.watch_poll(wid)[0] == 404
+    # one-shot: consumed and deregistered; the miss carries the
+    # watcher-cleared errorCode so clients know to re-watch
+    status, body, _ = api.watch_poll(wid)
+    assert status == 400 and body["errorCode"] == 400
 
 
 def test_watch_history_immediate(api):
