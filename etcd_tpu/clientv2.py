@@ -200,9 +200,13 @@ class HttpV2Api:
     headers) surface as V2Api, over real HTTP against a gateway — the
     client/v2 httpClient path (client.go) collapsed to urllib."""
 
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0, tls=None):
+        from etcd_tpu.transport import resolve_client_context
+
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # transport.TLSInfo (or ssl.SSLContext) for https gateways
+        self._ctx = resolve_client_context(tls)
 
     def _do(self, method: str, path: str, form: dict | None,
             as_json: bool = False) -> tuple[int, dict, dict]:
@@ -230,7 +234,8 @@ class HttpV2Api:
         req = urllib.request.Request(
             url, data=data, method=method, headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ctx) as r:
                 body, hdrs = json.loads(r.read() or b"{}"), r.headers
                 status = r.status
         except urllib.error.HTTPError as e:
@@ -358,9 +363,9 @@ class ClientV2:
     basic auth."""
 
     def __init__(self, ec_or_api, username: str | None = None,
-                 password: str | None = None):
+                 password: str | None = None, tls=None):
         if isinstance(ec_or_api, str):
-            api: Any = HttpV2Api(ec_or_api)
+            api: Any = HttpV2Api(ec_or_api, tls=tls)
         elif isinstance(ec_or_api, (V2Api, HttpV2Api, _AuthedApi)):
             api = ec_or_api
         else:
@@ -374,6 +379,6 @@ class ClientV2:
 
 
 def new(ec_or_api, username: str | None = None,
-        password: str | None = None) -> ClientV2:
-    """client.New analog."""
-    return ClientV2(ec_or_api, username, password)
+        password: str | None = None, tls=None) -> ClientV2:
+    """client.New analog; `tls` is a transport.TLSInfo for https."""
+    return ClientV2(ec_or_api, username, password, tls=tls)

@@ -57,6 +57,19 @@ class Config:
     # The manual tick() path (tests) leaves monitoring to explicit
     # monitor_versions() calls so tick counts stay deterministic.
     monitor_version_ticks: int = 50
+    # Transport security (embed/config.go ClientTLSInfo + ClientAutoTLS).
+    # client_tls serves the gateway over HTTPS; client_auto_tls
+    # generates a self-signed cert under data_dir/fixtures/client
+    # (config.go:677 self-signed path). The reference's PeerTLSInfo has
+    # NO analog on purpose: member-to-member consensus inside one fleet
+    # is an on-device tensor exchange — there is no peer socket to
+    # encrypt, and offering a knob that protects nothing would mislead.
+    client_tls: "object | None" = None   # transport.TLSInfo
+    client_auto_tls: bool = False
+    # --unsafe-no-fsync (embed/config.go UnsafeNoFsync): skip the
+    # fsync-before-ack durability barrier. Faster, loses acknowledged
+    # writes on kill -9.
+    unsafe_no_fsync: bool = False
 
     def validate(self) -> None:
         if self.cluster_size < 1:
@@ -78,6 +91,13 @@ class Config:
             )
         if self.force_new_cluster and not self.data_dir:
             raise ValueError("force_new_cluster requires a data_dir")
+        if self.client_tls is not None and self.client_auto_tls:
+            raise ValueError(
+                "client_tls and client_auto_tls are mutually exclusive")
+        if self.client_auto_tls and not self.data_dir:
+            # the self-signed keypair lives under data_dir/fixtures
+            # like the reference's auto-TLS (embed/config.go:677)
+            raise ValueError("auto TLS requires a data_dir")
 
 
 class Etcd:
@@ -101,8 +121,10 @@ class Etcd:
             self.server, cfg.auto_compaction_mode,
             cfg.auto_compaction_retention,
         )
+        self.client_tls = self._resolve_tls(cfg)
         self.http = V3Server(
-            self.server, cfg.listen_client_host, cfg.listen_client_port
+            self.server, cfg.listen_client_host, cfg.listen_client_port,
+            tls_info=self.client_tls,
         ).start()
         # contention detector over the tick cadence (pkg/contention armed
         # at 2x the interval, etcdserver/raft.go:133)
@@ -116,6 +138,34 @@ class Etcd:
             self._ticker = threading.Thread(target=self._tick_loop,
                                             daemon=True)
             self._ticker.start()
+
+    @staticmethod
+    def _resolve_tls(cfg: Config):
+        """ClientTLSInfo resolution incl. the auto-TLS self-signed path
+        (embed/config.go:677): the generated keypair lives under
+        data_dir/fixtures/client and is reused across restarts."""
+        import os
+
+        from etcd_tpu.transport import self_cert
+
+        client = cfg.client_tls
+        if client is None and cfg.client_auto_tls:
+            hosts = [cfg.listen_client_host, "localhost", "127.0.0.1"]
+            if cfg.listen_client_host in ("0.0.0.0", "::", ""):
+                # a wildcard listen address is never what clients dial:
+                # cover this machine's name + addresses in the SANs
+                import socket
+
+                name = socket.gethostname()
+                hosts.append(name)
+                try:
+                    hosts.extend({ai[4][0] for ai in
+                                  socket.getaddrinfo(name, None)})
+                except OSError:
+                    pass
+            client = self_cert(
+                os.path.join(cfg.data_dir, "fixtures", "client"), hosts)
+        return client
 
     @staticmethod
     def _bootstrap(cfg: Config, raft_cfg) -> EtcdCluster:
@@ -141,6 +191,9 @@ class Etcd:
             quota_bytes=cfg.quota_backend_bytes,
             auth_token=cfg.auth_token,
             auth_jwt_key=cfg.auth_jwt_key,
+            # a server process must not lose acknowledged writes to
+            # kill -9 (--unsafe-no-fsync is the reference's opt-out)
+            durable_proposes=not cfg.unsafe_no_fsync,
         )
         n = cfg.cluster_size
         have = [
@@ -182,7 +235,8 @@ class Etcd:
 
     @property
     def client_url(self) -> str:
-        return f"http://{self.config.listen_client_host}:{self.http.port}"
+        return (f"{self.http.scheme}://"
+                f"{self.config.listen_client_host}:{self.http.port}")
 
     def _tick_loop(self) -> None:
         period = self.config.tick_ms / 1000.0
@@ -217,7 +271,6 @@ class Etcd:
                     self.server.advance_lease_clock()
                 self.compactor.tick()
                 if ticks % sync_every == 0:
-                    from etcd_tpu.server.kvserver import ServerError
                     from etcd_tpu.types import NONE_ID
                     from etcd_tpu.utils.logging import get_logger
 
@@ -230,10 +283,12 @@ class Etcd:
                                 .v2store.has_ttl_keys():
                             self.server.v2_sync()
                         sync_failed = False
-                    except ServerError as e:
-                        # lost leadership / backpressure mid-pass; the
-                        # next pass retries — but say so once per streak
-                        # (silent failure here means TTLs never expire)
+                    except Exception as e:
+                        # lost leadership, backpressure, or an apply
+                        # error — the next pass retries; NOTHING may
+                        # escape and kill the ticker thread (raft
+                        # ticks, lease clock and compaction all ride
+                        # it). Say so once per failure streak.
                         if not sync_failed:
                             get_logger().warning(
                                 "v2 SYNC proposal failed: %s", e)

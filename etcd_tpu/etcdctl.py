@@ -15,34 +15,60 @@ import argparse
 import base64
 import json
 import sys
-import urllib.request
 
 
 class Ctl:
-    def __init__(self, endpoint: str, token: str | None = None):
-        self.endpoint = endpoint.rstrip("/")
-        self.token = token
+    """Thin CLI boundary over client.RemoteClient: one wire transport,
+    with gateway errors translated to exit-code-1 SystemExit the way a
+    CLI reports them."""
+
+    def __init__(self, endpoint: str, token: str | None = None,
+                 tls=None):
+        from etcd_tpu.client import RemoteClient
+
+        # transport.TLSInfo (or a prebuilt ssl.SSLContext) for https
+        # endpoints — --cacert/--cert/--key (ctlv3 global flags).
+        # timeout=None: CLI ops (snapshot save, long txns) block like
+        # the reference ctl rather than dying at an arbitrary 10s.
+        self._rc = RemoteClient(endpoint, token=token, tls=tls,
+                                timeout=None)
+
+    @property
+    def endpoint(self) -> str:
+        return self._rc.endpoint
+
+    @property
+    def token(self):
+        return self._rc.token
+
+    @token.setter
+    def token(self, tok):
+        self._rc.token = tok
 
     def call(self, path: str, body: dict) -> dict:
-        req = urllib.request.Request(
-            self.endpoint + path,
-            data=json.dumps(body).encode(),
-            headers={
-                "Content-Type": "application/json",
-                **({"Authorization": self.token} if self.token else {}),
-            },
-            method="POST",
-        )
+        import urllib.error
+
+        from etcd_tpu.client import RemoteError
+
         try:
-            with urllib.request.urlopen(req) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            out = json.loads(e.read() or b"{}")
-            raise SystemExit(f"Error: {out.get('error', e)}")
+            return self._rc.call(path, body)
+        except RemoteError as e:
+            raise SystemExit(f"Error: {e}") from None
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            # connection failures are CLI errors, not tracebacks
+            raise SystemExit(f"Error: {e}") from None
 
     def get_http(self, path: str) -> bytes:
-        with urllib.request.urlopen(self.endpoint + path) as resp:
-            return resp.read()
+        import urllib.error
+
+        try:
+            return self._rc.get_raw(path)
+        except urllib.error.HTTPError as e:
+            # /health answers 503 with {"health":"false",...} when
+            # leaderless — that body IS the answer, not a traceback
+            return e.read()
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise SystemExit(f"Error: {e}") from None
 
 
 def b64(s: str | bytes) -> str:
@@ -65,6 +91,18 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="etcdctl-tpu")
     p.add_argument("--endpoint", default="http://127.0.0.1:2379")
     p.add_argument("--user", default=None, help="name:password")
+    # TLS global flags (ctlv3 --cacert/--cert/--key/
+    # --insecure-skip-tls-verify)
+    p.add_argument("--cacert", default=None,
+                   help="verify the server cert against this CA bundle")
+    p.add_argument("--cert", default=None, dest="tls_cert",
+                   help="client TLS cert (mutual TLS / cert-CN auth)")
+    # dest must NOT be "key": nearly every subcommand has a `key`
+    # positional that would clobber the path
+    p.add_argument("--key", default=None, dest="tls_key",
+                   help="key for --cert")
+    p.add_argument("--insecure-skip-tls-verify", action="store_true",
+                   help="skip server cert verification (testing only)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     g = sub.add_parser("get")
@@ -175,7 +213,18 @@ def main(argv=None) -> int:
     v2u.add_argument("value")
 
     args = p.parse_args(argv)
-    ctl = Ctl(args.endpoint)
+    tls = None
+    if args.cacert or args.tls_cert or args.tls_key or \
+            args.insecure_skip_tls_verify:
+        from etcd_tpu.transport import TLSInfo
+
+        tls = TLSInfo(
+            trusted_ca_file=args.cacert or "",
+            client_cert_file=args.tls_cert or "",
+            client_key_file=args.tls_key or "",
+            insecure_skip_verify=args.insecure_skip_tls_verify,
+        )
+    ctl = Ctl(args.endpoint, tls=tls)
     if args.user:
         name, _, pw = args.user.partition(":")
         ctl.token = ctl.call("/v3/auth/authenticate",
@@ -340,7 +389,7 @@ def main(argv=None) -> int:
     elif c == "v2":
         from etcd_tpu import clientv2
 
-        cli = clientv2.new(args.endpoint)
+        cli = clientv2.new(args.endpoint, tls=tls)
         vc = args.v2_cmd
         try:
             if vc == "get":

@@ -34,6 +34,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--auto-compaction-retention", type=int, default=0)
     p.add_argument("--pre-vote", action=argparse.BooleanOptionalAction,
                    default=True)
+    # transport security (etcdmain --cert-file family, config.go
+    # ClientTLSInfo + ClientAutoTLS)
+    p.add_argument("--cert-file", default=None,
+                   help="server TLS cert; enables HTTPS")
+    p.add_argument("--key-file", default=None,
+                   help="key for --cert-file")
+    p.add_argument("--trusted-ca-file", default=None,
+                   help="CA bundle for verifying client certs")
+    p.add_argument("--client-cert-auth", action="store_true",
+                   help="require CA-verified client certs; the cert CN "
+                   "is accepted as the user identity")
+    p.add_argument("--auto-tls", action="store_true",
+                   help="self-signed TLS under data-dir/fixtures/client")
+    p.add_argument("--unsafe-no-fsync", action="store_true",
+                   help="skip fsync-before-ack (may lose acknowledged "
+                   "writes on crash)")
     # cluster bootstrap via a discovery service (etcdmain --discovery):
     # "<gateway-url>/<token>"; cluster size comes from the token's
     # _config/size record (v2discovery)
@@ -150,6 +166,19 @@ def main(argv=None) -> int:
         cluster_size = len(cluster_str.split(","))
         print(f"discovery: joined cluster [{cluster_str}]",
               file=sys.stderr)
+    client_tls = None
+    if args.cert_file or args.key_file or args.trusted_ca_file or \
+            args.client_cert_auth:
+        # ANY tls flag builds the TLSInfo so half-configurations fail
+        # startup loudly instead of silently serving plaintext
+        from etcd_tpu.transport import TLSInfo
+
+        client_tls = TLSInfo(
+            cert_file=args.cert_file or "",
+            key_file=args.key_file or "",
+            trusted_ca_file=args.trusted_ca_file or "",
+            client_cert_auth=args.client_cert_auth,
+        )
     cfg = Config(
         name=args.name,
         data_dir=args.data_dir,
@@ -162,6 +191,9 @@ def main(argv=None) -> int:
         auto_compaction_mode=args.auto_compaction_mode,
         auto_compaction_retention=args.auto_compaction_retention,
         pre_vote=args.pre_vote,
+        client_tls=client_tls,
+        client_auto_tls=args.auto_tls,
+        unsafe_no_fsync=args.unsafe_no_fsync,
     )
     etcd = start_etcd(cfg)
     print(f"etcd-tpu '{cfg.name}' serving {etcd.client_url} "

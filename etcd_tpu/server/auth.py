@@ -371,8 +371,17 @@ class AuthStore:
         self.tokens[token] = (name, self.revision, self.now + self.TOKEN_TTL)
         return token
 
+    # Transport-injected certificate identities: the gateway prefixes
+    # the verified client-cert CN with this namespace (and strips any
+    # wire-supplied "cert:" Authorization header, so only the TLS layer
+    # can mint one). AuthInfoFromTLS (server/auth/store.go:985-1020):
+    # the CN is the username at the CURRENT auth revision, no password.
+    CERT_TOKEN_PREFIX = "cert:"
+
     def auth_info(self, token: str) -> tuple[str, int]:
         """(username, revision) for a live token."""
+        if token.startswith(self.CERT_TOKEN_PREFIX):
+            return token[len(self.CERT_TOKEN_PREFIX):], self.revision
         if self.jwt is not None:
             return self.jwt.info(token, self.now)
         v = self.tokens.get(token)

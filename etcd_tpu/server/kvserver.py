@@ -165,8 +165,17 @@ class EtcdCluster:
         data_dir: str | None = None,
         auth_token: str = "simple",
         auth_jwt_key: bytes | None = None,
+        durable_proposes: bool = False,
     ):
         self.cl = cluster or Cluster(n_members=n_members)
+        # acknowledged ⇒ on disk: fsync the members' backends before a
+        # propose returns (the reference gets this from WAL MustSync
+        # before the Ready is acked, storage.go; here the device ring
+        # is the log and dies with the process, so the durable floor is
+        # the backend record log). Off by default for in-process
+        # harness/test clusters; embed turns it on unless the operator
+        # passes --unsafe-no-fsync.
+        self.durable_proposes = durable_proposes
         self.c = c
         self.M = self.cl.spec.M
         self.quota_bytes = quota_bytes
@@ -278,10 +287,7 @@ class EtcdCluster:
             if len(set(live)) <= 1:
                 break
             self.step()
-        for ms in self.members:
-            if not ms.crashed and ms.backend is not None:
-                ms.backend.commit()
-                ms.durable_index = ms.applied_index
+        self.commit_backends()
 
     def stabilize(self, max_rounds: int = 64) -> None:
         self.cl.step()
@@ -351,6 +357,15 @@ class EtcdCluster:
             if ms.backend is not None and not ms.crashed:
                 self._persist(ms, int(terms_now[m]))
         self._gc_requests()
+
+    def commit_backends(self) -> None:
+        """Flush + fsync every live member's staged batch so the durable
+        floor reaches the current applied front (the per-ack half of
+        sync_for_shutdown's drain)."""
+        for ms in self.members:
+            if ms.backend is not None and not ms.crashed:
+                ms.backend.commit()
+                ms.durable_index = ms.applied_index
 
     def _persist(self, ms: MemberState, term: int) -> None:
         """Write the apply batch behind the member: new MVCC revisions +
@@ -986,6 +1001,9 @@ class EtcdCluster:
                     res = serving.results.pop(word)
                     if isinstance(res, Exception):
                         raise res
+                    if self.durable_proposes:
+                        self.commit_backends()
+                        trace.step("backends fsynced")
                     return res
             raise ErrTimeout(req["kind"])
         finally:

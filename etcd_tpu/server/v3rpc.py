@@ -391,13 +391,25 @@ class V3Api:
     }
 
     def auth(self, suffix: str, q: dict) -> dict:
-        q.pop("_token", None)
+        tok = q.pop("_token", None)
         if suffix == "authenticate":
-            tok = self.ec.authenticate(q["name"], q["password"])
-            return {"token": tok, "header": {}}
+            out = self.ec.authenticate(q["name"], q["password"])
+            return {"token": out, "header": {}}
         kind = self.AUTH_OPS.get(suffix)
         if kind is None:
             raise ServerError(f"unknown auth op {suffix}")
+        # AdminPermission (server/etcdserver/v3_server.go AuthInfoFromCtx
+        # + auth store's root-role requirement): once auth is on, every
+        # admin op needs the root role — via password token or cert-CN
+        # identity. Without this the whole auth layer is one
+        # /v3/auth/disable away from moot.
+        lead = self.ec.ensure_leader()
+        a = self.ec.members[lead].auth
+        if a.enabled:
+            if tok is None:
+                raise ServerError(
+                    "auth admin: token or cert identity required")
+            a.is_admin(tok)
         kw = {k: v for k, v in q.items()}
         if kind == "auth_role_grant_permission":
             from etcd_tpu.server.auth import Permission
@@ -511,23 +523,105 @@ ROUTES = {
 }
 
 
+class _QuietServer(ThreadingHTTPServer):
+    """Failed TLS handshakes and client disconnects are the client's
+    story, not server stderr noise; anything else (fd exhaustion, disk
+    full, bugs) still gets the default traceback."""
+
+    def handle_error(self, request, client_address):
+        import errno
+        import ssl
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ssl.SSLError, ConnectionError,
+                            TimeoutError)):
+            return
+        if isinstance(exc, OSError) and exc.errno in (
+                errno.ECONNRESET, errno.EPIPE, errno.ETIMEDOUT,
+                errno.ECONNABORTED):
+            return
+        super().handle_error(request, client_address)
+
+
 class V3Server:
-    """HTTP transport wrapper around V3Api + the etcdhttp endpoints."""
+    """HTTP transport wrapper around V3Api + the etcdhttp endpoints.
+
+    With `tls_info` the listener speaks HTTPS (the NewTLSListener path,
+    client/pkg/transport/listener_tls.go): optional required-client-cert
+    verification against the trusted CA, the post-handshake
+    allowed-CN/hostname gate, and — when client certs are verified —
+    the peer CN as a request identity (AuthInfoFromTLS,
+    server/auth/store.go:985: the CN is the username, no password)."""
 
     def __init__(self, ec: EtcdCluster, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, tls_info=None):
         from etcd_tpu.server.v2http import KEYS_PREFIX, V2Api
 
         self.api = V3Api(ec)
         api = self.api
         self.v2api = V2Api(ec)
         v2api = self.v2api
+        if tls_info is not None and tls_info.empty():
+            # a half-configured TLSInfo must fail startup, never
+            # silently downgrade to plaintext (listener.go:345)
+            raise ValueError(
+                "KeyFile and CertFile must both be present in tls_info")
+        tls = tls_info
+        self.tls_info = tls
+        self.scheme = "https" if tls else "http"
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
+            # TLS handshakes run HERE, per-connection in the handler
+            # thread (wrap_socket defers them) — a client that connects
+            # and sends nothing must never stall the accept loop
+            HANDSHAKE_TIMEOUT = 30.0
+
+            def setup(self):
+                if tls is not None and hasattr(self.request,
+                                               "do_handshake"):
+                    self.request.settimeout(self.HANDSHAKE_TIMEOUT)
+                    self.request.do_handshake()  # raises -> conn dropped
+                    self.request.settimeout(None)
+                super().setup()
+
             def log_message(self, *a):  # quiet
                 pass
+
+            def _tls_gate(self) -> bool:
+                """allowed-CN / allowed-hostname constraint
+                (listener_tls.go:43): False ⇒ request rejected."""
+                if tls is None or (not tls.allowed_cn and
+                                   not tls.allowed_hostname):
+                    return True
+                from etcd_tpu.transport import check_cert_constraints
+
+                if check_cert_constraints(self.connection,
+                                          tls.allowed_cn,
+                                          tls.allowed_hostname):
+                    return True
+                # drain the body so a keep-alive connection stays in
+                # sync after the rejection (empty read = client gone)
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                while n > 0:
+                    chunk = self.rfile.read(min(n, 1 << 16))
+                    if not chunk:
+                        break
+                    n -= len(chunk)
+                self._send(403, {"error": "client certificate "
+                                 "constraint not satisfied"})
+                return False
+
+            def _cert_cn(self) -> str | None:
+                """Verified client-cert CN, only when the listener
+                actually verifies client certs."""
+                if tls is None or not tls.client_cert_auth:
+                    return None
+                from etcd_tpu.transport import peer_common_name
+
+                return peer_common_name(self.connection)
 
             def _send(self, code: int, obj: dict,
                       headers: dict | None = None) -> None:
@@ -612,14 +706,20 @@ class V3Server:
                 return False
 
             def do_PUT(self):
+                if not self._tls_gate():
+                    return
                 if not self._maybe_v2():
                     self._send(404, {"error": "not found"})
 
             def do_DELETE(self):
+                if not self._tls_gate():
+                    return
                 if not self._maybe_v2():
                     self._send(404, {"error": "not found"})
 
             def do_GET(self):
+                if not self._tls_gate():
+                    return
                 if self._maybe_v2():
                     return
                 # etcdhttp: /health, /version, /metrics (api/etcdhttp)
@@ -670,6 +770,8 @@ class V3Server:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):
+                if not self._tls_gate():
+                    return
                 if self._maybe_v2():
                     return
                 n = int(self.headers.get("Content-Length", "0"))
@@ -678,9 +780,27 @@ class V3Server:
                 except json.JSONDecodeError:
                     self._send(400, {"error": "bad json", "code": 3})
                     return
+                if not isinstance(q, dict):
+                    self._send(400, {"error": "request body must be a "
+                                     "JSON object", "code": 3})
+                    return
+                # _token is a transport-layer field: a JSON body that
+                # smuggles one (e.g. "cert:root") must never reach the
+                # handlers as an identity
+                q.pop("_token", None)
                 tok = self.headers.get("Authorization")
-                if tok:
+                # "cert:" is the transport-injected identity namespace —
+                # never accepted from the wire (a client must not spoof
+                # a cert identity through the Authorization header)
+                if tok and not tok.startswith("cert:"):
                     q["_token"] = tok
+                else:
+                    cn = self._cert_cn()
+                    if cn is not None:
+                        # AuthInfoFromTLS (store.go:985): the verified
+                        # client cert CN authenticates as that user,
+                        # no password/token required
+                        q["_token"] = "cert:" + cn
                 path = self.path
                 if path.startswith("/v3/auth/"):
                     suffix = path[len("/v3/auth/"):].replace("/", "_")
@@ -688,7 +808,11 @@ class V3Server:
                         try:
                             self._send(200, api.auth(suffix, q))
                         except Exception as e:
-                            self._send(400, {"error": str(e), "code": 3})
+                            # AuthError subclasses often carry no
+                            # message — the class name IS the error
+                            self._send(400, {
+                                "error": str(e) or type(e).__name__,
+                                "code": 3})
                     return
                 name = ROUTES.get(path)
                 if name is None:
@@ -702,7 +826,22 @@ class V3Server:
                     except Exception as e:  # pragma: no cover
                         self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        # build the SSL context BEFORE binding so a bad cert path or
+        # invalid constraint combination fails without leaking a bound
+        # listener socket
+        ssl_ctx = tls.server_context() if tls is not None else None
+        self.httpd = _QuietServer((host, port), Handler)
+        if tls is not None:
+            # wrap the listening socket with DEFERRED handshakes:
+            # accept() stays instant in the serve_forever thread, and
+            # Handler.setup() handshakes in the per-connection thread
+            # (a stalled or garbage client costs one worker thread for
+            # HANDSHAKE_TIMEOUT, not the accept loop). Failed
+            # handshakes raise there; _QuietServer drops them silently
+            # — the client sees the TLS alert, the server keeps serving.
+            self.httpd.socket = ssl_ctx.wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
 

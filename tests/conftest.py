@@ -55,3 +55,13 @@ def _bound_executable_accumulation():
     if _CLEAR_EVERY and _test_count[0] % _CLEAR_EVERY == 0:
         jax.clear_caches()
         gc.collect()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "e2e: out-of-process tier — spawns etcdmain subprocesses")
+    config.addinivalue_line(
+        "markers",
+        "smoke: fast core-correctness tier (-m smoke for quick "
+        "iteration on models/raft.py edits)")

@@ -1,0 +1,349 @@
+"""Transport security: self-signed cert generation, HTTPS gateway with
+CA verification, handshake failures, mutual TLS, the allowed-CN gate,
+and certificate-CN auth (client/pkg/transport listener.go:185 SelfCert,
+listener_tls.go:43, server/auth/store.go:985 AuthInfoFromTLS)."""
+import os
+import ssl
+import urllib.error
+
+import pytest
+
+from etcd_tpu import clientv2
+from etcd_tpu.client import RemoteClient, RemoteError
+from etcd_tpu.embed import Config, start_etcd
+from etcd_tpu.transport import (
+    TLSInfo,
+    generate_ca,
+    issue_cert,
+    self_cert,
+)
+
+
+# ------------------------------------------------------- cert generation
+
+def test_self_cert_generates_and_reuses(tmp_path):
+    d = str(tmp_path / "sc")
+    info = self_cert(d, ["127.0.0.1", "localhost"])
+    assert os.path.exists(info.cert_file)
+    assert os.path.exists(info.key_file)
+    assert info.trusted_ca_file == info.cert_file  # its own trust root
+    assert (os.stat(info.key_file).st_mode & 0o777) == 0o600
+    before = open(info.cert_file, "rb").read()
+    info2 = self_cert(d, ["10.0.0.1"])  # reused, NOT regenerated
+    assert open(info2.cert_file, "rb").read() == before
+
+
+def test_ca_issue_cert_cn(tmp_path):
+    from cryptography import x509
+    from cryptography.x509.oid import NameOID
+
+    ca = generate_ca(str(tmp_path / "ca"))
+    leaf = issue_cert(str(tmp_path / "ca"), ca, "alice")
+    cert = x509.load_pem_x509_certificate(
+        open(leaf.cert_file, "rb").read())
+    cns = cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)
+    assert cns[0].value == "alice"
+    assert leaf.trusted_ca_file == ca.cert_file
+
+
+def test_server_context_requires_keypair():
+    with pytest.raises(ValueError, match="must both be present"):
+        TLSInfo().server_context()
+    with pytest.raises(ValueError, match="requires a trusted CA"):
+        TLSInfo(cert_file="x", key_file="y",
+                client_cert_auth=True).server_context()
+
+
+# ------------------------------------------------- auto-TLS HTTPS server
+
+@pytest.fixture(scope="module")
+def https_etcd(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("httpsd"))
+    e = start_etcd(Config(cluster_size=1, data_dir=d,
+                          client_auto_tls=True, auto_tick=False))
+    yield e
+    e.close()
+
+
+def _ca_of(e) -> TLSInfo:
+    return TLSInfo(trusted_ca_file=e.client_tls.cert_file)
+
+
+def test_https_roundtrip_with_ca_verification(https_etcd):
+    assert https_etcd.client_url.startswith("https://")
+    cli = RemoteClient(https_etcd.client_url, tls=_ca_of(https_etcd))
+    cli.put(b"/tls/a", b"v1")
+    assert cli.get(b"/tls/a") == b"v1"
+    assert cli.get_prefix(b"/tls/") == [(b"/tls/a", b"v1")]
+    st = cli.status()
+    assert "db_size" in st
+
+
+def test_https_rejected_without_ca(https_etcd):
+    """Default trust store doesn't contain the self-signed cert: the
+    handshake must fail (no silent fallback to plaintext)."""
+    cli = RemoteClient(https_etcd.client_url)
+    with pytest.raises(urllib.error.URLError) as ei:
+        cli.get(b"/tls/a")
+    assert isinstance(ei.value.reason, ssl.SSLError)
+
+
+def test_https_rejected_with_wrong_ca(https_etcd, tmp_path):
+    other = generate_ca(str(tmp_path / "otherca"))
+    cli = RemoteClient(
+        https_etcd.client_url,
+        tls=TLSInfo(trusted_ca_file=other.cert_file))
+    with pytest.raises(urllib.error.URLError):
+        cli.get(b"/tls/a")
+
+
+def test_https_insecure_skip_verify(https_etcd):
+    cli = RemoteClient(https_etcd.client_url,
+                       tls=TLSInfo(insecure_skip_verify=True))
+    cli.put(b"/tls/skip", b"ok")
+    assert cli.get(b"/tls/skip") == b"ok"
+
+
+def test_etcdctl_over_https(https_etcd, capsys):
+    from etcd_tpu import etcdctl
+
+    ep = ["--endpoint", https_etcd.client_url,
+          "--cacert", https_etcd.client_tls.cert_file]
+    assert etcdctl.main([*ep, "put", "/tls/ctl", "cv"]) == 0
+    capsys.readouterr()
+    assert etcdctl.main([*ep, "get", "/tls/ctl"]) == 0
+    assert "cv" in capsys.readouterr().out
+
+
+def test_clientv2_over_https(https_etcd):
+    cli = clientv2.new(https_etcd.client_url, tls=_ca_of(https_etcd))
+    assert cli.keys.set("/tlsv2/a", "v").action == "set"
+    assert cli.keys.get("/tlsv2/a").node["value"] == "v"
+
+
+def test_auto_tls_requires_data_dir():
+    with pytest.raises(ValueError, match="auto TLS requires a data_dir"):
+        Config(cluster_size=1, client_auto_tls=True).validate()
+
+
+# -------------------------------------------- mutual TLS + cert-CN auth
+
+@pytest.fixture(scope="module")
+def mtls(tmp_path_factory):
+    """CA + server/alice/bob certs + an embed server requiring client
+    certs, with auth enabled and alice scoped to /app/*."""
+    d = str(tmp_path_factory.mktemp("mtls"))
+    ca = generate_ca(os.path.join(d, "certs"))
+    server = issue_cert(os.path.join(d, "certs"), ca, "server",
+                        hosts=["127.0.0.1", "localhost"])
+    alice = issue_cert(os.path.join(d, "certs"), ca, "alice")
+    bob = issue_cert(os.path.join(d, "certs"), ca, "bob")
+    e = start_etcd(Config(
+        cluster_size=1, data_dir=os.path.join(d, "data"),
+        auto_tick=False,
+        client_tls=TLSInfo(
+            cert_file=server.cert_file, key_file=server.key_file,
+            trusted_ca_file=ca.cert_file, client_cert_auth=True)))
+    # admin bootstrap over the wire (any CA-signed cert may connect)
+    admin = RemoteClient(e.client_url, tls=TLSInfo(
+        trusted_ca_file=ca.cert_file,
+        client_cert_file=alice.cert_file,
+        client_key_file=alice.key_file))
+    admin.call("/v3/auth/user/add", {"name": "root", "password": "rpw"})
+    admin.call("/v3/auth/role/add", {"name": "root"})
+    admin.call("/v3/auth/user/grant", {"name": "root", "role": "root"})
+    admin.call("/v3/auth/user/add", {"name": "alice", "password": "apw"})
+    admin.call("/v3/auth/role/add", {"name": "app"})
+    admin.call("/v3/auth/role/grant", {
+        "name": "app",
+        "perm": {"permType": "READWRITE",
+                 "key": RemoteClient._b64(b"/app/"),
+                 "range_end": RemoteClient._b64(b"/app0")}})
+    admin.call("/v3/auth/user/grant", {"name": "alice", "role": "app"})
+    admin.call("/v3/auth/enable", {})
+    yield {"e": e, "ca": ca, "alice": alice, "bob": bob}
+    e.close()
+
+
+def test_mtls_handshake_requires_client_cert(mtls):
+    # TLS 1.3: the client may only see the certificate-required alert
+    # on its first read, as a raw SSLError rather than a wrapped
+    # URLError — either way the connection is refused
+    cli = RemoteClient(
+        mtls["e"].client_url,
+        tls=TLSInfo(trusted_ca_file=mtls["ca"].cert_file))  # no cert
+    with pytest.raises((urllib.error.URLError, ssl.SSLError,
+                        ConnectionError)):
+        cli.get(b"/app/x")
+
+
+def test_cert_cn_authenticates_without_password(mtls):
+    """AuthInfoFromTLS: the verified cert CN is the user — no token,
+    no password, permissions enforced for that user."""
+    alice = RemoteClient(mtls["e"].client_url, tls=TLSInfo(
+        trusted_ca_file=mtls["ca"].cert_file,
+        client_cert_file=mtls["alice"].cert_file,
+        client_key_file=mtls["alice"].key_file))
+    alice.put(b"/app/x", b"from-cert")
+    assert alice.get(b"/app/x") == b"from-cert"
+    with pytest.raises(RemoteError, match="[Pp]ermission"):
+        alice.put(b"/outside", b"nope")
+
+
+def test_cert_cn_unknown_user_rejected(mtls):
+    """bob's cert verifies, but no 'bob' user exists: authz fails."""
+    bob = RemoteClient(mtls["e"].client_url, tls=TLSInfo(
+        trusted_ca_file=mtls["ca"].cert_file,
+        client_cert_file=mtls["bob"].cert_file,
+        client_key_file=mtls["bob"].key_file))
+    with pytest.raises(RemoteError):
+        bob.put(b"/app/x", b"nope")
+
+
+def test_cert_token_not_spoofable_from_wire(mtls):
+    """Authorization: cert:root from the wire must NOT become a cert
+    identity — the transport strips it and the real cert CN wins."""
+    alice = RemoteClient(mtls["e"].client_url, token="cert:root",
+                         tls=TLSInfo(
+                             trusted_ca_file=mtls["ca"].cert_file,
+                             client_cert_file=mtls["alice"].cert_file,
+                             client_key_file=mtls["alice"].key_file))
+    with pytest.raises(RemoteError, match="[Pp]ermission"):
+        alice.put(b"/outside", b"nope")  # root could; alice cannot
+    alice.put(b"/app/spoof", b"still-alice")  # alice's scope still works
+
+
+def test_cert_token_not_spoofable_via_body(mtls):
+    """A "_token": "cert:root" smuggled in the JSON BODY (not the
+    Authorization header) must be stripped before it can impersonate a
+    TLS identity."""
+    alice = RemoteClient(mtls["e"].client_url, tls=TLSInfo(
+        trusted_ca_file=mtls["ca"].cert_file,
+        client_cert_file=mtls["alice"].cert_file,
+        client_key_file=mtls["alice"].key_file))
+    with pytest.raises(RemoteError, match="[Pp]ermission"):
+        alice.call("/v3/kv/put", {
+            "key": RemoteClient._b64(b"/outside"),
+            "value": RemoteClient._b64(b"x"),
+            "_token": "cert:root",
+        })
+
+
+def test_password_token_still_works_over_mtls(mtls):
+    """Token auth composes with mutual TLS: an explicit Authorization
+    token outranks the cert CN (the reference prefers the token when
+    both are present)."""
+    root = RemoteClient(mtls["e"].client_url, tls=TLSInfo(
+        trusted_ca_file=mtls["ca"].cert_file,
+        client_cert_file=mtls["alice"].cert_file,
+        client_key_file=mtls["alice"].key_file))
+    root.login("root", "rpw")
+    root.put(b"/outside", b"root-can")  # alice's cert alone could not
+    assert root.get(b"/outside") == b"root-can"
+
+
+def test_auth_admin_requires_root(mtls):
+    """With auth enabled, /v3/auth admin ops need the root role —
+    a valid non-root cert identity is not enough (AdminPermission)."""
+    alice = RemoteClient(mtls["e"].client_url, tls=TLSInfo(
+        trusted_ca_file=mtls["ca"].cert_file,
+        client_cert_file=mtls["alice"].cert_file,
+        client_key_file=mtls["alice"].key_file))
+    with pytest.raises(RemoteError):
+        alice.call("/v3/auth/disable", {})
+    with pytest.raises(RemoteError):
+        alice.call("/v3/auth/user/add",
+                   {"name": "mallory", "password": "m"})
+    # root (password token) still administers
+    root = RemoteClient(mtls["e"].client_url, tls=TLSInfo(
+        trusted_ca_file=mtls["ca"].cert_file,
+        client_cert_file=mtls["alice"].cert_file,
+        client_key_file=mtls["alice"].key_file)).login("root", "rpw")
+    root.call("/v3/auth/user/add", {"name": "temp", "password": "t"})
+    # a mutating admin op bumps the auth revision: the old token is
+    # now ErrAuthOldRevision and the client must re-authenticate
+    # (auth/store.go revision discipline)
+    with pytest.raises(RemoteError, match="OldRevision"):
+        root.call("/v3/auth/user/delete", {"name": "temp"})
+    root.login("root", "rpw")
+    root.call("/v3/auth/user/delete", {"name": "temp"})
+
+
+def test_etcdctl_mutual_tls_key_flag(mtls):
+    """--key must not collide with subcommand key positionals: mutual
+    TLS through the full etcdctl argv path."""
+    import contextlib
+    import io
+
+    from etcd_tpu import etcdctl
+
+    ep = ["--endpoint", mtls["e"].client_url,
+          "--cacert", mtls["ca"].cert_file,
+          "--cert", mtls["alice"].cert_file,
+          "--key", mtls["alice"].key_file]
+    assert etcdctl.main([*ep, "put", "/app/ctl", "mv"]) == 0
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        assert etcdctl.main([*ep, "get", "/app/ctl"]) == 0
+    assert "mv" in out.getvalue()
+
+
+def test_half_configured_tls_fails_loudly(tmp_path):
+    """CA-only server TLSInfo must fail startup, not silently serve
+    plaintext; a client cert without its key must error at config."""
+    with pytest.raises(ValueError, match="must both be present"):
+        start_etcd(Config(
+            cluster_size=1, data_dir=str(tmp_path / "d"),
+            auto_tick=False,
+            client_tls=TLSInfo(trusted_ca_file="ca.pem",
+                               client_cert_auth=True)))
+    with pytest.raises(ValueError, match="must both be present"):
+        TLSInfo(client_cert_file="alice.pem").client_context()
+
+
+def test_stalled_client_does_not_block_accepts(https_etcd):
+    """A TCP client that connects and never handshakes must not stall
+    other clients (handshakes are deferred to handler threads)."""
+    import socket
+
+    host, port = "127.0.0.1", https_etcd.http.port
+    stalled = socket.create_connection((host, port))
+    try:
+        cli = RemoteClient(https_etcd.client_url,
+                           tls=_ca_of(https_etcd), timeout=10)
+        cli.put(b"/tls/notblocked", b"v")
+        assert cli.get(b"/tls/notblocked") == b"v"
+    finally:
+        stalled.close()
+
+
+# ------------------------------------------------------ allowed-CN gate
+
+def test_allowed_cn_gate(tmp_path):
+    d = str(tmp_path)
+    ca = generate_ca(os.path.join(d, "certs"))
+    server = issue_cert(os.path.join(d, "certs"), ca, "server",
+                        hosts=["127.0.0.1", "localhost"])
+    alice = issue_cert(os.path.join(d, "certs"), ca, "alice")
+    bob = issue_cert(os.path.join(d, "certs"), ca, "bob")
+    e = start_etcd(Config(
+        cluster_size=1, data_dir=os.path.join(d, "data"),
+        auto_tick=False,
+        client_tls=TLSInfo(
+            cert_file=server.cert_file, key_file=server.key_file,
+            trusted_ca_file=ca.cert_file, client_cert_auth=True,
+            allowed_cn="alice")))
+    try:
+        ok = RemoteClient(e.client_url, tls=TLSInfo(
+            trusted_ca_file=ca.cert_file,
+            client_cert_file=alice.cert_file,
+            client_key_file=alice.key_file))
+        ok.put(b"/cn/a", b"v")
+        bad = RemoteClient(e.client_url, tls=TLSInfo(
+            trusted_ca_file=ca.cert_file,
+            client_cert_file=bob.cert_file,
+            client_key_file=bob.key_file))
+        with pytest.raises(RemoteError, match="constraint"):
+            bad.put(b"/cn/b", b"v")
+    finally:
+        e.close()
