@@ -253,7 +253,9 @@ class RemoteClient:
         except urllib.error.HTTPError as e:
             out = e.read()
             try:
-                msg = json.loads(out or b"{}").get("error", "")
+                parsed = json.loads(out or b"{}")
+                msg = parsed.get("error", "") if isinstance(
+                    parsed, dict) else str(parsed)
             except json.JSONDecodeError:
                 msg = out.decode(errors="replace")
             raise RemoteError(msg or f"HTTP {e.code}") from None

@@ -34,10 +34,6 @@ class Ctl:
                                 timeout=None)
 
     @property
-    def endpoint(self) -> str:
-        return self._rc.endpoint
-
-    @property
     def token(self):
         return self._rc.token
 
@@ -326,7 +322,18 @@ def main(argv=None) -> int:
         if args.ep_cmd == "status":
             print(json.dumps(ctl.call("/v3/maintenance/status", {})))
         elif args.ep_cmd == "health":
-            print(ctl.get_http("/health").decode().strip())
+            body = ctl.get_http("/health").decode().strip()
+            print(body)
+            try:
+                parsed = json.loads(body)
+                healthy = isinstance(parsed, dict) and \
+                    parsed.get("health") == "true"
+            except json.JSONDecodeError:
+                healthy = False
+            if not healthy:
+                # scripts gate on the exit code (`endpoint health &&
+                # deploy`), like the reference ctl
+                return 1
         else:
             print(ctl.call("/v3/maintenance/hash", {})["hash"])
     elif c == "alarm":

@@ -7,11 +7,11 @@ per user, auth/range_perm_cache.go), and every mutation bumps an
 *auth revision* so tokens minted under an older ACL are rejected
 (store.go's authRevision / ErrAuthOldRevision). Two token providers, as in
 the reference (auth/store.go NewTokenProvider): `simple` — opaque TTL'd
-random tokens held in node-local memory — and `jwt` — stateless HS256
-tokens carrying {username, revision, exp} claims (auth/jwt.go:28,117;
-HMAC instead of the reference's RSA/ECDSA default because stdlib has no
-asymmetric crypto, matching jwt.go's symmetric-key branch where the same
-key signs and verifies).
+random tokens held in node-local memory — and `jwt` — stateless signed
+tokens carrying {username, revision, exp} claims (auth/jwt.go:28,117)
+with the reference's full sign-method set (options.go:88-103):
+HS256/384/512 shared-secret HMAC plus RS*/PS*/ES* PEM keypairs, and
+verify-only operation when only a public key is configured.
 """
 from __future__ import annotations
 
@@ -75,8 +75,22 @@ def _b64url_dec(s: str) -> bytes:
     return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
 
+_JWT_HASHES = {"256": hashlib.sha256, "384": hashlib.sha384,
+               "512": hashlib.sha512}
+# ES* fixed-width (r||s) coordinate sizes per curve (RFC 7518 §3.4)
+_EC_COORD_BYTES = {"secp256r1": 32, "secp384r1": 48, "secp521r1": 66}
+_ES_CURVE = {"ES256": "secp256r1", "ES384": "secp384r1",
+             "ES512": "secp521r1"}
+
+
 class JWTTokenProvider:
-    """Stateless HS256 JWT provider (auth/jwt.go:28 tokenJWT).
+    """Stateless JWT provider (auth/jwt.go:28 tokenJWT).
+
+    Sign methods mirror the reference's (auth/options.go:88-103 +
+    jwt.go:152-156): HS256/384/512 (HMAC shared secret), RS*/PS* (RSA /
+    RSA-PSS PEM keypair), ES* (ECDSA PEM keypair on the matching
+    curve). A PUBLIC key yields a verify-only provider — it can check
+    tokens minted elsewhere but not assign (jwt.go:150-160 verifyOnly).
 
     assign() mints {username, revision, exp} claims (jwt.go:117-127);
     info() verifies the signature + algorithm and rejects expired tokens.
@@ -86,17 +100,132 @@ class JWTTokenProvider:
     """
 
     def __init__(self, key: bytes, ttl: int = 300, sign_method: str = "HS256"):
-        if sign_method != "HS256":
-            raise AuthError(f"unsupported jwt sign method {sign_method!r} "
-                            "(stdlib build supports HS256 only)")
+        family, bits = sign_method[:2], sign_method[2:]
+        if family not in ("HS", "RS", "PS", "ES") or \
+                bits not in _JWT_HASHES:
+            raise AuthError(f"unsupported jwt sign method {sign_method!r}")
         if not key:
             raise AuthError("jwt token provider requires a signing key")
-        self.key = key
         self.ttl = ttl
         self.sign_method = sign_method
+        self._family = family
+        self._hash = _JWT_HASHES[bits]
+        self.verify_only = False
+        if family == "HS":
+            self.key = key
+            self._priv = self._pub = None
+        else:
+            self.key = None
+            self._priv, self._pub = self._load_asym_key(key)
+
+    def _load_asym_key(self, pem: bytes):
+        """PEM private key → (priv, pub); PEM public key → (None, pub)
+        for verify-only providers. Key type must match the method."""
+        try:
+            from cryptography.hazmat.primitives import serialization
+            from cryptography.hazmat.primitives.asymmetric import ec, rsa
+        except ImportError:
+            raise AuthError(
+                f"jwt {self.sign_method} needs the 'cryptography' "
+                "package; only HS* methods work without it") from None
+
+        priv = pub = None
+        try:
+            priv = serialization.load_pem_private_key(pem, password=None)
+            pub = priv.public_key()
+        except TypeError:
+            raise AuthError(
+                f"jwt {self.sign_method}: password-protected private "
+                "keys are not supported") from None
+        except ValueError:
+            try:
+                pub = serialization.load_pem_public_key(pem)
+            except (ValueError, TypeError):
+                raise AuthError(
+                    f"jwt {self.sign_method}: key is neither a PEM "
+                    "private nor public key") from None
+            self.verify_only = True
+        except Exception as e:  # UnsupportedAlgorithm and kin
+            raise AuthError(
+                f"jwt {self.sign_method}: cannot load key: {e}") from None
+        want = rsa.RSAPublicKey if self._family in ("RS", "PS") \
+            else ec.EllipticCurvePublicKey
+        if not isinstance(pub, want):
+            raise AuthError(
+                f"jwt {self.sign_method} requires an "
+                f"{'RSA' if self._family != 'ES' else 'ECDSA'} key")
+        if self._family == "ES":
+            want_curve = _ES_CURVE[self.sign_method]
+            if pub.curve.name != want_curve:
+                raise AuthError(
+                    f"jwt {self.sign_method} requires curve "
+                    f"{want_curve}, got {pub.curve.name}")
+        return priv, pub
+
+    def _crypto_hash(self):
+        from cryptography.hazmat.primitives import hashes
+
+        return {hashlib.sha256: hashes.SHA256, hashlib.sha384:
+                hashes.SHA384, hashlib.sha512: hashes.SHA512}[
+                    self._hash]()
+
+    def _rsa_padding(self, for_verify: bool = False):
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        if self._family == "PS":
+            h = self._crypto_hash()
+            # sign with salt = digest size (RFC 7518); verify with AUTO
+            # so tokens from signers using max-length salt (golang-jwt,
+            # hence reference-built etcds) also pass
+            salt = padding.PSS.AUTO if for_verify else h.digest_size
+            return padding.PSS(mgf=padding.MGF1(h), salt_length=salt)
+        return padding.PKCS1v15()
 
     def _sign(self, signing_input: bytes) -> bytes:
-        return hmac.new(self.key, signing_input, hashlib.sha256).digest()
+        if self._family == "HS":
+            return hmac.new(self.key, signing_input, self._hash).digest()
+        if self.verify_only or self._priv is None:
+            raise ErrInvalidAuthToken(
+                "verify-only jwt provider cannot assign tokens")
+        if self._family in ("RS", "PS"):
+            return self._priv.sign(signing_input, self._rsa_padding(),
+                                   self._crypto_hash())
+        # ES*: DER → fixed-width r||s (RFC 7518 §3.4)
+        from cryptography.hazmat.primitives.asymmetric import ec, utils
+
+        der = self._priv.sign(signing_input,
+                              ec.ECDSA(self._crypto_hash()))
+        r, s = utils.decode_dss_signature(der)
+        n = _EC_COORD_BYTES[self._priv.curve.name]
+        return r.to_bytes(n, "big") + s.to_bytes(n, "big")
+
+    def _verify(self, signing_input: bytes, sig: bytes) -> bool:
+        if self._family == "HS":
+            return hmac.compare_digest(self._sign(signing_input), sig)
+        from cryptography.exceptions import InvalidSignature
+
+        try:
+            if self._family in ("RS", "PS"):
+                self._pub.verify(sig, signing_input,
+                                 self._rsa_padding(for_verify=True),
+                                 self._crypto_hash())
+                return True
+            from cryptography.hazmat.primitives.asymmetric import (
+                ec,
+                utils,
+            )
+
+            n = _EC_COORD_BYTES[self._pub.curve.name]
+            if len(sig) != 2 * n:
+                return False
+            der = utils.encode_dss_signature(
+                int.from_bytes(sig[:n], "big"),
+                int.from_bytes(sig[n:], "big"))
+            self._pub.verify(der, signing_input,
+                             ec.ECDSA(self._crypto_hash()))
+            return True
+        except InvalidSignature:
+            return False
 
     def assign(self, username: str, revision: int, now: int) -> str:
         header = _b64url(json.dumps(
@@ -115,8 +244,8 @@ class JWTTokenProvider:
             header = json.loads(_b64url_dec(header_s))
             if header.get("alg") != self.sign_method:
                 raise ErrInvalidAuthToken("invalid signing method")
-            want = self._sign(f"{header_s}.{claims_s}".encode())
-            if not hmac.compare_digest(want, _b64url_dec(sig_s)):
+            if not self._verify(f"{header_s}.{claims_s}".encode(),
+                                _b64url_dec(sig_s)):
                 raise ErrInvalidAuthToken("bad signature")
             claims = json.loads(_b64url_dec(claims_s))
             username = claims["username"]
@@ -366,6 +495,12 @@ class AuthStore:
         if not u.no_password and _hash(password, u.salt) != u.pw_hash:
             raise ErrAuthFailed()
         if self.jwt is not None:
+            if self.jwt.verify_only:
+                # a public-key provider can check tokens but not mint:
+                # this is a server config issue, not a bad credential
+                raise AuthError(
+                    "jwt provider is verify-only (public key "
+                    "configured): this server cannot mint tokens")
             return self.jwt.assign(name, self.revision, self.now)
         token = f"{name}.{secrets.token_hex(16)}"
         self.tokens[token] = (name, self.revision, self.now + self.TOKEN_TTL)

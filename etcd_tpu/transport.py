@@ -139,16 +139,34 @@ def _new_key():
 
 
 def _write_pem(cert, key, cert_path: str, key_path: str) -> None:
+    """Write key THEN cert, each via tmp-file + rename: the reuse guard
+    checks for both files, so writing the cert last means 'cert.pem
+    exists' implies a complete keypair — a crash mid-generation can
+    never leave a permanently broken pair behind."""
     from cryptography.hazmat.primitives import serialization
 
-    with open(cert_path, "wb") as f:
-        f.write(cert.public_bytes(serialization.Encoding.PEM))
-    fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    tmp_key = key_path + ".tmp"
+    fd = os.open(tmp_key, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
     with os.fdopen(fd, "wb") as f:
         f.write(key.private_bytes(
             serialization.Encoding.PEM,
             serialization.PrivateFormat.PKCS8,
             serialization.NoEncryption()))
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp_key, key_path)
+    tmp_cert = cert_path + ".tmp"
+    with open(tmp_cert, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp_cert, cert_path)
+    # fsync the directory so the renames themselves survive power loss
+    dfd = os.open(os.path.dirname(cert_path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 def _san_entries(hosts):

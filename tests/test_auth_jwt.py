@@ -80,7 +80,126 @@ def test_jwt_provider_requires_key_and_known_method():
     with pytest.raises(AuthError):
         JWTTokenProvider(b"")
     with pytest.raises(AuthError):
-        JWTTokenProvider(KEY, sign_method="RS256")  # stdlib build: HS256 only
+        JWTTokenProvider(KEY, sign_method="none")
+    with pytest.raises(AuthError):
+        JWTTokenProvider(KEY, sign_method="XX256")
+    with pytest.raises(AuthError):
+        # an HMAC secret is not a PEM keypair
+        JWTTokenProvider(KEY, sign_method="RS256")
+
+
+# ---------------------------------------------- asymmetric sign methods
+# (auth/jwt.go:152-156 + options.go:88-103: RSA / RSA-PSS / ECDSA)
+
+def _rsa_pem() -> bytes:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    k = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    return k.private_bytes(serialization.Encoding.PEM,
+                           serialization.PrivateFormat.PKCS8,
+                           serialization.NoEncryption())
+
+
+def _ec_pem(curve=None) -> bytes:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    k = ec.generate_private_key(curve or ec.SECP256R1())
+    return k.private_bytes(serialization.Encoding.PEM,
+                           serialization.PrivateFormat.PKCS8,
+                           serialization.NoEncryption())
+
+
+def _pub_of(pem: bytes) -> bytes:
+    from cryptography.hazmat.primitives import serialization
+
+    k = serialization.load_pem_private_key(pem, password=None)
+    return k.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+
+
+def _ec384_pem() -> bytes:
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    return _ec_pem(ec.SECP384R1())
+
+
+def _ec521_pem() -> bytes:
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    return _ec_pem(ec.SECP521R1())
+
+
+@pytest.mark.parametrize("method,keyfn", [
+    ("RS256", _rsa_pem), ("RS384", _rsa_pem), ("RS512", _rsa_pem),
+    ("PS256", _rsa_pem), ("PS384", _rsa_pem), ("PS512", _rsa_pem),
+    ("ES256", _ec_pem), ("ES384", _ec384_pem), ("ES512", _ec521_pem),
+    ("HS384", lambda: KEY), ("HS512", lambda: KEY),
+])
+def test_jwt_asymmetric_roundtrip(method, keyfn):
+    p = JWTTokenProvider(keyfn(), sign_method=method, ttl=100)
+    tok = p.assign("alice", 7, now=0)
+    assert p.info(tok, now=50) == ("alice", 7)
+    with pytest.raises(ErrInvalidAuthToken):
+        p.info(tok, now=100)  # expired
+    with pytest.raises(ErrInvalidAuthToken):
+        p.info(tok[:-6] + "AAAAAA", now=0)  # corrupted signature
+
+
+def test_jwt_asymmetric_wrong_key_rejected():
+    a = JWTTokenProvider(_rsa_pem(), sign_method="RS256")
+    b = JWTTokenProvider(_rsa_pem(), sign_method="RS256")
+    with pytest.raises(ErrInvalidAuthToken):
+        b.info(a.assign("alice", 1, now=0), now=0)
+
+
+def test_jwt_public_key_is_verify_only():
+    """jwt.go:150-160: a public key can verify tokens minted by the
+    private-key holder but cannot assign (verifyOnly)."""
+    priv_pem = _rsa_pem()
+    signer = JWTTokenProvider(priv_pem, sign_method="RS256")
+    verifier = JWTTokenProvider(_pub_of(priv_pem), sign_method="RS256")
+    assert verifier.verify_only
+    tok = signer.assign("alice", 3, now=0)
+    assert verifier.info(tok, now=0) == ("alice", 3)
+    with pytest.raises(ErrInvalidAuthToken):
+        verifier.assign("alice", 3, now=0)
+
+
+def test_jwt_es_curve_mismatch_rejected():
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    with pytest.raises(AuthError, match="curve"):
+        JWTTokenProvider(_ec_pem(ec.SECP384R1()), sign_method="ES256")
+    with pytest.raises(AuthError, match="ECDSA"):
+        JWTTokenProvider(_rsa_pem(), sign_method="ES256")
+    with pytest.raises(AuthError, match="RSA"):
+        JWTTokenProvider(_ec_pem(), sign_method="RS256")
+
+
+def test_jwt_cross_alg_confusion_rejected():
+    """An RS256 token presented to an HS256 provider (and vice versa)
+    dies at the alg check, never reaching key material."""
+    rsa_p = JWTTokenProvider(_rsa_pem(), sign_method="RS256")
+    hs_p = JWTTokenProvider(KEY)
+    with pytest.raises(ErrInvalidAuthToken):
+        hs_p.info(rsa_p.assign("alice", 1, now=0), now=0)
+    with pytest.raises(ErrInvalidAuthToken):
+        rsa_p.info(hs_p.assign("alice", 1, now=0), now=0)
+
+
+def test_authstore_rs256_end_to_end():
+    a = AuthStore(token="jwt,sign-method=RS256,ttl=50",
+                  jwt_key=_rsa_pem())
+    a.user_add("root", "rpw")
+    a.role_add("root")
+    a.user_grant_role("root", "root")
+    a.auth_enable()
+    tok = a.authenticate("root", "rpw")
+    assert tok.count(".") == 2
+    a.check(tok, b"anything", write=True)  # root passes authz
 
 
 def test_authstore_token_spec_parsing():
