@@ -149,22 +149,196 @@ def run_watch_latency(w: Wire, args) -> Report:
     return rep
 
 
+def run_txn_mixed(w: Wire, args) -> Report:
+    """txn_mixed.go: a mixed load of txn-put and txn-range at
+    --rw-ratio (reads per write)."""
+    w.call("/v3/kv/put", {"key": b64(b"bench/m0"), "value": b64(b"x")})
+    rep = Report()
+    reads = writes = 0
+    for i in range(args.total):
+        # keep the running mix at --rw-ratio reads per write, including
+        # fractional ratios (0.5 = two writes per read)
+        if reads >= args.rw_ratio * writes:
+            writes += 1
+            body = {"success": [{"request_put": {
+                "key": b64(b"bench/m%d" % (i % 64)),
+                "value": b64(b"v" * args.val_size)}}]}
+        else:
+            reads += 1
+            body = {"success": [{"request_range": {
+                "key": b64(b"bench/m0")}}]}
+        _timed(rep, lambda: w.call("/v3/kv/txn", body))
+    return rep
+
+
+def run_stm(w: Wire, args) -> Report:
+    """stm.go: optimistic read-modify-write transactions with conflict
+    retry (the clientv3/concurrency STM loop collapsed to a
+    compare-mod-revision txn)."""
+    nkeys = max(1, args.stm_keys)
+    for i in range(nkeys):
+        w.call("/v3/kv/put", {"key": b64(b"stm/%d" % i),
+                              "value": b64(b"0")})
+    rep = Report()
+    for i in range(args.total):
+        key = b64(b"stm/%d" % (i % nkeys))
+
+        def rmw():
+            while True:
+                got = w.call("/v3/kv/range", {"key": key})
+                kv = got["kvs"][0]
+                mod = kv["mod_revision"]
+                n = int(base64.b64decode(kv["value"]) or b"0")
+                res = w.call("/v3/kv/txn", {
+                    "compare": [{"key": key, "target": "MOD",
+                                 "result": "EQUAL",
+                                 "mod_revision": mod}],
+                    "success": [{"request_put": {
+                        "key": key, "value": b64(b"%d" % (n + 1))}}],
+                })
+                if res.get("succeeded"):
+                    return
+
+        _timed(rep, rmw)
+    return rep
+
+
+def run_lease(w: Wire, args) -> Report:
+    """lease.go: lease keepalive throughput over granted leases."""
+    # random ID base: reruns after an interrupted run (leases never
+    # revoked) and concurrent bench processes must not collide
+    base = int.from_bytes(os.urandom(4), "big") << 8
+    ids = []
+    for i in range(min(args.total, 64)):
+        out = w.call("/v3/lease/grant",
+                     {"ID": str(base + i), "TTL": "60"})
+        ids.append(out["ID"])
+    rep = Report()
+    for i in range(args.total):
+        lid = ids[i % len(ids)]
+        _timed(rep, lambda: w.call("/v3/lease/keepalive", {"ID": lid}))
+    for lid in ids:
+        w.call("/v3/lease/revoke", {"ID": lid})
+    return rep
+
+
+def run_watch(w: Wire, args) -> Report:
+    """watch.go: watcher creation throughput, then events/sec delivered
+    to --watchers watchers over --total puts."""
+    rep = Report()
+    wids = []
+    for i in range(args.watchers):
+        def create(i=i):
+            res = w.call("/v3/watch", {"create_request": {
+                "key": b64(b"bench/wf")}})
+            wids.append(res["watch_id"])
+
+        _timed(rep, create)
+    delivered = 0
+    t0 = time.perf_counter()
+    for i in range(args.total):
+        w.call("/v3/kv/put", {"key": b64(b"bench/wf"),
+                              "value": b64(b"%d" % i)})
+    for wid in wids:
+        while True:
+            evs = w.call("/v3/watch", {"poll_request":
+                                       {"watch_id": wid}})["events"]
+            if not evs:
+                break
+            delivered += len(evs)
+    dt = time.perf_counter() - t0
+    create_s = sum(rep.lat) or 1e-9
+    print(f"watchers: {len(wids)} created at "
+          f"{len(wids) / create_s:.1f}/sec  events delivered: "
+          f"{delivered} ({delivered / dt:.1f} events/sec)")
+    print("(Summary below = watcher-creation latencies; its "
+          "Requests/sec divides by the whole run)")
+    for wid in wids:
+        w.call("/v3/watch", {"cancel_request": {"watch_id": wid}})
+    return rep
+
+
+def run_watch_get(w: Wire, args) -> Report:
+    """watch_get.go: --watchers watchers created at an OLD revision (so
+    each must catch up through history) racing serializable gets — the
+    unsynced-watcher contention bench."""
+    first = w.call("/v3/kv/put", {"key": b64(b"bench/wg"),
+                                  "value": b64(b"0")})
+    start_rev = int(first["header"].get("revision", 1))
+    for i in range(args.watch_events):
+        w.call("/v3/kv/put", {"key": b64(b"bench/wg"),
+                              "value": b64(b"%d" % i)})
+    wids = [w.call("/v3/watch", {"create_request": {
+        "key": b64(b"bench/wg"),
+        "start_revision": str(start_rev)}})["watch_id"]
+        for _ in range(args.watchers)]
+    rep = Report()  # get latency while watchers sync
+    for i in range(args.total):
+        _timed(rep, lambda: w.call(
+            "/v3/kv/range", {"key": b64(b"bench/wg"),
+                             "serializable": True}))
+    caught = 0
+    for wid in wids:
+        while True:
+            evs = w.call("/v3/watch", {"poll_request":
+                                       {"watch_id": wid}})["events"]
+            if not evs:
+                break
+            caught += len(evs)
+        w.call("/v3/watch", {"cancel_request": {"watch_id": wid}})
+    print(f"watchers: {len(wids)}  catch-up events: {caught}")
+    return rep
+
+
+def run_mvcc_put(_w, args) -> Report:
+    """mvcc-put.go: the DIRECT storage bench — puts straight into a
+    host MVCC store with no consensus, wire, or JSON in the path.
+    Isolates the host apply layer's ceiling (the honest denominator
+    for wire-path numbers)."""
+    from etcd_tpu.server.mvcc import MVCCStore
+
+    st = MVCCStore()
+    val = b"v" * args.val_size
+    keys = [os.urandom(max(args.key_size // 2, 1)).hex().encode()
+            for _ in range(args.total)]
+    rep = Report()
+    for k in keys:
+        def one_put(k=k):
+            txn = st.write_txn()
+            txn.put(k, val)
+            txn.end()
+
+        _timed(rep, one_put)
+    return rep
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="benchmark-tpu")
     p.add_argument("--endpoint", default="http://127.0.0.1:2379")
     sub = p.add_subparsers(dest="cmd", required=True)
-    for name in ("put", "range", "txn-put", "watch-latency"):
+    for name in ("put", "range", "txn-put", "txn-mixed", "stm", "lease",
+                 "watch", "watch-get", "watch-latency", "mvcc-put"):
         s = sub.add_parser(name)
         s.add_argument("--total", type=int, default=100)
         s.add_argument("--key-size", type=int, default=8)
         s.add_argument("--val-size", type=int, default=32)
         if name == "range":
             s.add_argument("--serializable", action="store_true")
+        if name == "txn-mixed":
+            s.add_argument("--rw-ratio", type=float, default=1.0)
+        if name == "stm":
+            s.add_argument("--stm-keys", type=int, default=8)
+        if name in ("watch", "watch-get"):
+            s.add_argument("--watchers", type=int, default=10)
+        if name == "watch-get":
+            s.add_argument("--watch-events", type=int, default=50)
     args = p.parse_args(argv)
     w = Wire(args.endpoint)
     runner = {
         "put": run_put, "range": run_range, "txn-put": run_txn_put,
-        "watch-latency": run_watch_latency,
+        "txn-mixed": run_txn_mixed, "stm": run_stm, "lease": run_lease,
+        "watch": run_watch, "watch-get": run_watch_get,
+        "watch-latency": run_watch_latency, "mvcc-put": run_mvcc_put,
     }[args.cmd]
     t0 = time.perf_counter()
     rep = runner(w, args)

@@ -140,6 +140,130 @@ def cmd_defrag(args) -> int:
     return 0
 
 
+def cmd_backup(args) -> int:
+    """etcdutl backup (etcdutl/etcdutl/backup_command.go): offline copy
+    of a data dir to a fresh directory. Like the reference, this is a
+    REWRITE rather than a file copy: each member backend is loaded to
+    its last committed point (dropping any torn tail) and re-serialized
+    cleanly, so the backup is always openable. The manifest records
+    per-member consistent index / revision / hash for later integrity
+    checks."""
+    from etcd_tpu.storage import schema
+    from etcd_tpu.storage.backend import Backend
+
+    paths = _member_paths(args.data_dir)
+    if not paths:
+        print(f"no member backends under {args.data_dir}",
+              file=sys.stderr)
+        return 1
+    os.makedirs(args.backup_dir, exist_ok=True)
+    leftover = _member_paths(args.backup_dir)
+    if leftover:
+        # stale member files would silently mix with this backup and
+        # boot as one inconsistent cluster — refuse
+        print(f"backup dir {args.backup_dir} already contains "
+              f"{len(leftover)} member backend(s); use an empty dir",
+              file=sys.stderr)
+        return 1
+    manifest = []
+    for path in paths:
+        be, meta, store = _load(path)
+        dst = os.path.join(args.backup_dir, os.path.basename(path))
+        out = Backend(dst, fresh=True)
+        schema.persist_mvcc_delta(out, store, 0)
+        schema.save_applied_meta(
+            out,
+            index=meta.get("consistent_index", 0),
+            term=meta.get("term", 0),
+            store=store,
+            lease_snap=meta.get("lease"),
+            auth_snap=meta.get("auth"),
+            alarms=meta.get("alarms", []),
+            cluster_version=meta.get("cluster_version"),
+            downgrade=meta.get("downgrade"),
+            v2=meta.get("v2"),
+        )
+        sv = schema.get_storage_version(be)
+        if sv is not None:
+            schema.set_storage_version(out, sv)
+        out.commit()
+        out.close()
+        be.close()
+        manifest.append({
+            "member": os.path.basename(path),
+            "consistent_index": meta.get("consistent_index", 0),
+            "revision": store.current_rev,
+            "hash": store.hash_kv(),
+        })
+    with open(os.path.join(args.backup_dir,
+                           "backup_manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    print(json.dumps({"backed_up": len(manifest),
+                      "backup_dir": args.backup_dir}))
+    return 0
+
+
+def cmd_migrate(args) -> int:
+    """etcdutl migrate (etcdutl/etcdutl/migrate_command.go): move a data
+    dir's storage schema to --target-version ("X.Y"). Upgrading to 3.6
+    writes the storage-version field; downgrading to 3.5 removes it —
+    refused while 3.6-only content exists (an active downgrade job)
+    unless --force, mirroring schema.Migrate's unknown-field check."""
+    from etcd_tpu.storage import schema
+
+    target = args.target_version
+    if target.count(".") != 1:
+        print(f'wrong target version format, expected "X.Y", '
+              f'got {target!r}', file=sys.stderr)
+        return 1
+    if target not in (schema.MIN_STORAGE_VERSION,
+                      schema.CURRENT_STORAGE_VERSION):
+        print(f"unsupported target storage version {target!r} "
+              f"(supported: {schema.MIN_STORAGE_VERSION}, "
+              f"{schema.CURRENT_STORAGE_VERSION})", file=sys.stderr)
+        return 1
+    paths = _member_paths(args.data_dir)
+    if not paths:
+        print(f"no member backends under {args.data_dir}",
+              file=sys.stderr)
+        return 1
+    from etcd_tpu.storage.backend import Backend
+
+    # phase 1: validate EVERY member before mutating any — a mid-loop
+    # failure must not leave the dir at mixed storage versions
+    loaded = []
+    for path in paths:
+        # meta + the version field only — no need to replay the full
+        # MVCC history just to flip one meta record
+        be = Backend(path)
+        meta = schema.load_applied_meta(be) or {}
+        if target == schema.MIN_STORAGE_VERSION and \
+                (meta.get("downgrade") or {}).get("enabled") and \
+                not args.force:
+            print(f"{os.path.basename(path)}: active downgrade "
+                  f"record is not understood by {target}; cancel it "
+                  "or pass --force", file=sys.stderr)
+            for b, _ in loaded:
+                b.close()
+            be.close()
+            return 1
+        loaded.append((be, path))
+    # phase 2: apply
+    results = []
+    for be, path in loaded:
+        current = schema.get_storage_version(be) or \
+            schema.MIN_STORAGE_VERSION
+        if current != target:
+            schema.set_storage_version(be, target)
+            be.commit()
+        be.close()
+        results.append({"member": os.path.basename(path),
+                        "version": target,
+                        "changed": current != target})
+    print(json.dumps(results, indent=2))
+    return 0
+
+
 def cmd_status(args) -> int:
     out = []
     for path in _member_paths(args.data_dir):
@@ -181,6 +305,15 @@ def main(argv=None) -> int:
     s = sub.add_parser("status")
     s.add_argument("--data-dir", required=True)
 
+    b = sub.add_parser("backup")
+    b.add_argument("--data-dir", required=True)
+    b.add_argument("--backup-dir", required=True)
+
+    m = sub.add_parser("migrate")
+    m.add_argument("--data-dir", required=True)
+    m.add_argument("--target-version", required=True)
+    m.add_argument("--force", action="store_true")
+
     args = p.parse_args(argv)
     if args.cmd == "snapshot":
         if args.snap_cmd == "restore":
@@ -190,6 +323,10 @@ def main(argv=None) -> int:
         return cmd_hashkv(args)
     if args.cmd == "defrag":
         return cmd_defrag(args)
+    if args.cmd == "backup":
+        return cmd_backup(args)
+    if args.cmd == "migrate":
+        return cmd_migrate(args)
     return cmd_status(args)
 
 

@@ -167,18 +167,19 @@ class V3Api:
             token=q.get("_token"),
         )
         responses = []
-        for kind, payload in res["responses"]:
+        for entry in res["responses"]:
+            kind = entry[0]
             if kind == "put":
                 responses.append({"response_put": {"header": {}}})
             elif kind == "delete":
                 responses.append(
-                    {"response_delete_range": {"deleted": str(payload)}}
+                    {"response_delete_range": {"deleted": str(entry[1])}}
                 )
-            else:
+            else:  # ("range", kvs, count) — a 3-tuple, unlike the others
                 responses.append({
                     "response_range": {
-                        "kvs": [_kv_json(kv) for kv in payload[0]],
-                        "count": str(payload[1]),
+                        "kvs": [_kv_json(kv) for kv in entry[1]],
+                        "count": str(entry[2]),
                     }
                 })
         return {

@@ -150,3 +150,24 @@ def load_mvcc(be: Backend, max_rev: int | None = None,
         st.current_rev = max(m for m, _ in st.revs)
     st.compact_rev = compact_rev
     return st
+
+
+# ---- storage version (storage/schema/schema.go + version.go): the field
+# was introduced "in 3.6" — its ABSENCE means the 3.5 layout. Migrate up
+# writes it; migrate down removes it.
+_STORAGE_VERSION_KEY = b"storage_version"
+CURRENT_STORAGE_VERSION = "3.6"
+MIN_STORAGE_VERSION = "3.5"
+
+
+def set_storage_version(be: Backend, version: str | None) -> None:
+    if version is None or version == MIN_STORAGE_VERSION:
+        be.delete(META_BUCKET, _STORAGE_VERSION_KEY)
+    else:
+        be.put(META_BUCKET, _STORAGE_VERSION_KEY, version.encode())
+
+
+def get_storage_version(be: Backend) -> str | None:
+    """None = the pre-field (3.5-equivalent) layout."""
+    raw = be.get(META_BUCKET, _STORAGE_VERSION_KEY)
+    return raw.decode() if raw else None

@@ -21,7 +21,8 @@ def run(etcd, *argv) -> str:
     old = sys.stdout
     sys.stdout = out
     try:
-        rc = benchmark.main(["--endpoint", etcd.client_url, *argv])
+        ep = ["--endpoint", etcd.client_url] if etcd else []
+        rc = benchmark.main([*ep, *argv])
     finally:
         sys.stdout = old
     assert rc == 0
@@ -39,4 +40,36 @@ def test_benchmark_txn_and_watch_latency(etcd):
     out = run(etcd, "txn-put", "--total", "10")
     assert "Summary:" in out
     out = run(etcd, "watch-latency", "--total", "5")
+    assert "Requests/sec:" in out
+
+
+def test_benchmark_txn_mixed_and_stm(etcd):
+    out = run(etcd, "txn-mixed", "--total", "10", "--rw-ratio", "2")
+    assert "Summary:" in out
+    out = run(etcd, "stm", "--total", "8", "--stm-keys", "3")
+    assert "Requests/sec:" in out
+    # STM actually incremented: each txn is one read-modify-write
+    from etcd_tpu.client import RemoteClient
+
+    c = RemoteClient(etcd.client_url)
+    total = sum(int(c.get(b"stm/%d" % i) or b"0") for i in range(3))
+    assert total == 8
+
+
+def test_benchmark_lease(etcd):
+    out = run(etcd, "lease", "--total", "10")
+    assert "Requests/sec:" in out
+
+
+def test_benchmark_watch_shapes(etcd):
+    out = run(etcd, "watch", "--total", "6", "--watchers", "3")
+    assert "events delivered: " in out and "Summary:" in out
+    out = run(etcd, "watch-get", "--total", "5", "--watchers", "2",
+              "--watch-events", "6")
+    assert "catch-up events: " in out
+
+
+def test_benchmark_mvcc_put():
+    """The direct-storage shape needs no server at all."""
+    out = run(None, "mvcc-put", "--total", "50", "--val-size", "16")
     assert "Requests/sec:" in out
