@@ -165,10 +165,17 @@ def cmd_backup(args) -> int:
               f"{len(leftover)} member backend(s); use an empty dir",
               file=sys.stderr)
         return 1
+    # stage into a scratch subdir and move files up only once ALL
+    # members serialized: a mid-loop failure (torn source, disk full)
+    # must never leave a bootable-looking partial backup behind
+    stage = os.path.join(args.backup_dir, ".partial")
+    os.makedirs(stage, exist_ok=True)
+    for name in os.listdir(stage):  # wipe a previous failed attempt
+        os.remove(os.path.join(stage, name))
     manifest = []
     for path in paths:
         be, meta, store = _load(path)
-        dst = os.path.join(args.backup_dir, os.path.basename(path))
+        dst = os.path.join(stage, os.path.basename(path))
         out = Backend(dst, fresh=True)
         schema.persist_mvcc_delta(out, store, 0)
         schema.save_applied_meta(
@@ -195,9 +202,12 @@ def cmd_backup(args) -> int:
             "revision": store.current_rev,
             "hash": store.hash_kv(),
         })
-    with open(os.path.join(args.backup_dir,
-                           "backup_manifest.json"), "w") as f:
+    with open(os.path.join(stage, "backup_manifest.json"), "w") as f:
         json.dump(manifest, f, indent=2)
+    for name in os.listdir(stage):
+        os.rename(os.path.join(stage, name),
+                  os.path.join(args.backup_dir, name))
+    os.rmdir(stage)
     print(json.dumps({"backed_up": len(manifest),
                       "backup_dir": args.backup_dir}))
     return 0

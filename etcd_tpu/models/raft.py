@@ -1381,6 +1381,15 @@ def apply_round(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox):
     (raft.go:554-570), and the ring compacts at the applied cursor when near
     capacity (the triggerSnapshot analog, server.go:1088-1104)."""
 
+    # Trace-time specialization (RaftConfig.entry_classes): when the
+    # program declares it never commits conf-change entries, the
+    # apply_conf_change mask algebra, the auto-leave pass and the
+    # leave-entry append below are statically dead and drop out — in a
+    # masked-SPMD step dead code costs like live code, and this block
+    # replays on all Spec.A serial slots.
+    handle_cc = cfg.entry_classes is None or \
+        "conf_change" in cfg.entry_classes
+
     def body(carry, _):
         n, ob = carry
         idx = n.applied + 1
@@ -1388,9 +1397,10 @@ def apply_round(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox):
         s = logops.slot(spec, idx)
         e_term = logops.ring_read(n.log_term, s)
         e_data = logops.ring_read(n.log_data, s)
-        e_type = logops.ring_read(n.log_type, s)
-        is_cc = can & (e_type == ENTRY_CONF_CHANGE)
-        n, ob = ccmod.apply_conf_change(cfg, spec, n, ob, e_data, is_cc)
+        if handle_cc:
+            e_type = logops.ring_read(n.log_type, s)
+            is_cc = can & (e_type == ENTRY_CONF_CHANGE)
+            n, ob = ccmod.apply_conf_change(cfg, spec, n, ob, e_data, is_cc)
         n = n.replace(
             applied=jnp.where(can, idx, n.applied),
             applied_hash=jnp.where(
@@ -1406,25 +1416,28 @@ def apply_round(cfg: RaftConfig, spec: Spec, n: NodeState, ob: Outbox):
 
     (n, ob), _ = jax.lax.scan(body, (n, ob), None, length=spec.A)
 
-    # auto-leave joint config (advance(), raft.go:554-570)
-    al = (
-        (n.role == ROLE_LEADER)
-        & n.auto_leave
-        & is_joint(n)
-        & (n.applied >= n.pending_conf_index)
-    )
-    zE = jnp.zeros((spec.E,), jnp.int32)
-    leave_data = zE.at[0].set(ccmod.encode_leave_joint())
-    leave_type = zE.at[0].set(ENTRY_CONF_CHANGE)
-    n, acc = append_entries_state(
-        cfg, spec, n, 1, leave_data, leave_type, al, count_quota=False
-    )
-    n = n.replace(
-        pending_conf_index=jnp.where(al & acc, n.last_index, n.pending_conf_index)
-    )
-    # NB: append only — no immediate bcast. The reference's advance()
-    # (raft.go:554-570) appends the leave entry without broadcasting;
-    # followers pick it up from the next triggered send.
+    if handle_cc:
+        # auto-leave joint config (advance(), raft.go:554-570) — only
+        # reachable through committed conf changes, so it specializes
+        # away with them
+        al = (
+            (n.role == ROLE_LEADER)
+            & n.auto_leave
+            & is_joint(n)
+            & (n.applied >= n.pending_conf_index)
+        )
+        zE = jnp.zeros((spec.E,), jnp.int32)
+        leave_data = zE.at[0].set(ccmod.encode_leave_joint())
+        leave_type = zE.at[0].set(ENTRY_CONF_CHANGE)
+        n, acc = append_entries_state(
+            cfg, spec, n, 1, leave_data, leave_type, al, count_quota=False
+        )
+        n = n.replace(
+            pending_conf_index=jnp.where(al & acc, n.last_index, n.pending_conf_index)
+        )
+        # NB: append only — no immediate bcast. The reference's advance()
+        # (raft.go:554-570) appends the leave entry without broadcasting;
+        # followers pick it up from the next triggered send.
 
     # compaction: snapshot at the applied cursor when the ring is nearly full
     occ = n.last_index - n.snap_index

@@ -59,6 +59,19 @@ class RaftConfig:
     # demotion stay unconditionally — they key on message TERMS and
     # roles, not on declared classes.
     message_classes: tuple | None = None
+    # Which ENTRY types this program's APPLY path handles (None = all).
+    # The A-slot apply scan (apply_round) replays apply_conf_change's
+    # joint-config mask algebra on every one of Spec.A serial slots even
+    # when no conf-change entry can be committed — profiled at 9.5% of
+    # the steady round (PROFILE.md round 5), the largest single source
+    # line after deferred emission landed. A program that never proposes
+    # membership changes declares entry_classes=("normal",) and the
+    # conf-change apply block, the auto-leave pass and the leave-entry
+    # append DROP OUT AT TRACE TIME. Contract: bit-identical while no
+    # ENTRY_CONF_CHANGE entry commits and the fleet neither starts in
+    # nor enters a joint configuration
+    # (tests/test_apply_specialization.py proves it on steady traffic).
+    entry_classes: tuple | None = None
     # Compact each node's inbox (nonempty slots to the front, original
     # order preserved) and process only the first `inbox_bound` slots per
     # round instead of all M*K. Messages past the bound are DROPPED —
@@ -152,6 +165,14 @@ class RaftConfig:
                         "is not in message_classes — its messages would be "
                         "silently swallowed"
                     )
+        if self.entry_classes is not None:
+            bad = set(self.entry_classes) - {"normal", "conf_change"}
+            if bad:
+                # a typo'd class name must not silently drop the
+                # conf-change apply block
+                raise ValueError(
+                    f"unknown entry_classes {sorted(bad)}; known: "
+                    "['conf_change', 'normal']")
         if self.deferred_emit and not self.coalesce_commit_refresh:
             # without coalescing, the leader's per-ack commit broadcast
             # fires inside the scan — exactly the write the deferral is

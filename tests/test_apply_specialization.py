@@ -1,0 +1,113 @@
+"""RaftConfig.entry_classes: trace-time removal of the conf-change
+apply block from the A-slot apply scan (plus the auto-leave pass and
+leave-entry append). Equivalence contract: while only ENTRY_NORMAL
+entries commit and the fleet never enters a joint configuration, the
+("normal",)-only program reproduces the full program bit-for-bit — the
+dropped block was a pure masked no-op replayed on every apply slot."""
+import dataclasses
+
+import numpy as np
+import jax
+
+from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
+from etcd_tpu.types import (
+    ENTRY_CONF_CHANGE,
+    ENTRY_NORMAL,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import RaftConfig
+
+SPEC = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+CFG = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                 inbox_bound=4, coalesce_commit_refresh=True)
+C = 4
+
+
+def _elect(full):
+    M, E = SPEC.M, SPEC.E
+    state = init_fleet(SPEC, C, seed=0, election_tick=CFG.election_tick)
+    inbox = empty_inbox(SPEC, C)
+    z2 = np.zeros((M, C), np.int32)
+    zp = np.zeros((M, E, C), np.int32)
+    no = np.zeros((M, C), bool)
+    keep = np.ones((M, M, C), bool)
+    hup = no.copy()
+    hup[0, :] = True
+    state, inbox = full(state, inbox, z2, zp, zp, z2, hup, no, keep)
+    for _ in range(12):
+        state, inbox = full(state, inbox, z2, zp, zp, z2, no, no, keep)
+    assert (np.asarray(state.role)[0] == ROLE_LEADER).all()
+    return state, inbox, (z2, zp, no, keep)
+
+
+def _run_pair(a, b, state0, inbox0, z2, zp, no, keep, rounds=10):
+    plen = z2.copy()
+    plen[0, :] = 1
+    pdata = zp.copy()
+    pdata[0, 0, :] = 7
+    ptype = zp.copy()
+    ptype[0, 0, :] = ENTRY_NORMAL
+    sa, ia = state0, inbox0
+    sb, ib = state0, inbox0
+    for _ in range(rounds):
+        sa, ia = a(sa, ia, plen, pdata, ptype, z2, no, no, keep)
+        sb, ib = b(sb, ib, plen, pdata, ptype, z2, no, no, keep)
+    assert int(np.asarray(sa.commit).min()) >= 8  # really replicating
+    for name in sa.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(sa, name)), np.asarray(getattr(sb, name))
+        ), f"state.{name}"
+    for name in ia.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(ia, name)), np.asarray(getattr(ib, name))
+        ), f"inbox.{name}"
+
+
+def test_normal_only_apply_program_is_bit_identical():
+    full = jax.jit(build_round(CFG, SPEC))
+    lean = jax.jit(build_round(
+        dataclasses.replace(CFG, entry_classes=("normal",)), SPEC))
+    state0, inbox0, (z2, zp, no, keep) = _elect(full)
+    _run_pair(full, lean, state0, inbox0, z2, zp, no, keep)
+
+
+def test_full_bench_stack_with_apply_specialization():
+    """entry_classes composes with the whole bench ladder
+    (local_steps + message_classes + deferred_emit)."""
+    from etcd_tpu.types import MSG_APP, MSG_APP_RESP, MSG_PROP
+
+    full = jax.jit(build_round(CFG, SPEC))
+    steady = jax.jit(build_round(
+        dataclasses.replace(
+            CFG,
+            local_steps=("prop",),
+            message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP),
+            deferred_emit=True,
+            entry_classes=("normal",),
+        ), SPEC))
+    state0, inbox0, (z2, zp, no, keep) = _elect(full)
+    _run_pair(full, steady, state0, inbox0, z2, zp, no, keep)
+
+
+def test_conf_change_still_applies_in_full_program():
+    """Sanity guard for the gate itself: the FULL program (default
+    entry_classes=None) still applies a committed conf change — i.e.
+    the specialization is opt-in, not a silent behavior change."""
+    from etcd_tpu.models import confchange as ccmod
+    from etcd_tpu.types import CC_REMOVE_NODE
+
+    full = jax.jit(build_round(CFG, SPEC))
+    state, inbox, (z2, zp, no, keep) = _elect(full)
+    # remove voter 4 via a single change through consensus
+    plen = z2.copy()
+    plen[0, :] = 1
+    pdata = zp.copy()
+    pdata[0, 0, :] = ccmod.encode([(CC_REMOVE_NODE, 4)])
+    ptype = zp.copy()
+    ptype[0, 0, :] = ENTRY_CONF_CHANGE
+    state, inbox = full(state, inbox, plen, pdata, ptype, z2, no, no,
+                        keep)
+    for _ in range(6):
+        state, inbox = full(state, inbox, z2, zp, zp, z2, no, no, keep)
+    assert not np.asarray(state.voters)[0, 4].any()  # applied on leader
