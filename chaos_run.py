@@ -72,6 +72,7 @@ def main() -> int:
         drop_p=float(os.environ.get("CHAOS_DROP", "0.02")),
         delay_p=float(os.environ.get("CHAOS_DELAY", "0.05")),
         partition_p=float(os.environ.get("CHAOS_PART", "0.1")),
+        sync_dispatch=os.environ.get("CHAOS_SYNC", "0") != "0",
     )
     rep["elapsed_s"] = round(time.perf_counter() - t0, 1)
     rep["platform"] = platform
@@ -129,6 +130,9 @@ def main() -> int:
             lrep = json.loads(lines[-1])
             lease_safe = (
                 not lrep["lease_violations"]
+                # the r5 gates: bounded indeterminacy + a request
+                # failure rate a retrying stresser actually sustains
+                and not lrep.get("lease_gate_failures")
                 and lrep["runner_exclusion_violations"] == 0
                 and lrep["runner_final_progress"]
             )

@@ -133,17 +133,9 @@ def main(argv=None) -> int:
     # sitecustomize re-pins the accelerator platform at interpreter
     # start, so the env var alone is not enough) and reuse the repo's
     # persistent compile cache for fast process starts
-    import os
+    from etcd_tpu.utils.cache import entrypoint_platform_setup
 
-    import jax
-
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    cache = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
-    if os.path.isdir(cache):
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    entrypoint_platform_setup()
 
     from etcd_tpu.embed import Config, start_etcd
 

@@ -82,28 +82,103 @@ def _bc(spec: Spec, mask, leaf):
     return mask
 
 
-def _held_wins(spec: Spec, held: Msg, fresh: Msg) -> Msg:
-    """Merge a held-message buffer over fresh traffic: a held message wins
-    a slot collision (the fresh one drops — legal per the transport
-    contract, etcdserver/raft.go:107-110). The type leaf merges under the
-    same mask as every other leaf, so liveness follows the values."""
-    live = held.type != 0
-    return jax.tree.map(
-        lambda h, f: jnp.where(_bc(spec, live, h), h, f), held, fresh
+# --------------------------------------------------------- sparse held
+# The original held buffer was a SECOND FULL INBOX (17 x [M, K*M, C]
+# leaves): at C=1M its while-loop double-buffering alone overflowed HBM
+# (measured 17.01G vs the 15.75G budget), capping fault epochs at 524k
+# groups. But delay faults are SPARSE — at delay_p=0.05 a sender row
+# (K*M = 10 slots) holds ~0.1-0.5 delayed messages a round — so the
+# buffer now packs each row's delayed messages into HELD_SLOTS compact
+# slots (index + fields), ~3x smaller than the dense plane and with
+# tiny [M, H, S, C] one-hot temporaries instead of full-inbox passes.
+# Overflow past HELD_SLOTS per row per round DROPS the extra messages —
+# legal by the transport contract (etcdserver/raft.go:107-110), and at
+# the chaos mixes' traffic (<=2 live slots per row in steady state)
+# P(>3 delayed in one row) is negligible.
+
+HELD_SLOTS = 3
+
+
+class HeldSparse(struct.PyTreeNode):
+    """Per-sender-row packed delayed messages: `idx[m, h, c]` is the
+    flat slot (0..K*M-1) the h-th held message came from (-1 = empty);
+    `msgs` leaves are [M, H(,E packed into H*E), C] in the wire dtype."""
+
+    idx: jnp.ndarray
+    msgs: Msg
+
+
+def empty_held(spec: Spec, C: int, wire_int16: bool) -> HeldSparse:
+    # eval_shape: only leaf shapes/dtypes are needed — materializing a
+    # real dense inbox here would transiently allocate the very
+    # multi-GB buffer this sparse form exists to avoid
+    inbox_sds = jax.eval_shape(
+        lambda: empty_inbox(spec, C, wire_int16=wire_int16))
+    H = HELD_SLOTS
+
+    def shrink(x):
+        S = spec.K * spec.M
+        e = x.shape[1] // S  # 1, or E for ent leaves
+        return jnp.zeros((spec.M, H * e, C), x.dtype)
+
+    return HeldSparse(
+        idx=jnp.full((spec.M, H, C), -1, jnp.int32),
+        msgs=jax.tree.map(shrink, inbox_sds),
     )
 
 
-def _merge_delayed(spec: Spec, out: Msg, held: Msg,
-                   delay_mask) -> tuple[Msg, Msg]:
+def _pack_held(spec: Spec, out: Msg, dm) -> HeldSparse:
+    """Compact this round's delayed slots (mask dm [M, S, C]) into the
+    sparse form: per sender row, the h-th delayed slot lands in held
+    slot h; extras past HELD_SLOTS drop."""
+    S = spec.K * spec.M
+    H = HELD_SLOTS
+    rank = jnp.cumsum(dm.astype(jnp.int32), axis=1) - 1        # [M, S, C]
+    sel = (
+        rank[:, None, :, :] == jnp.arange(H, dtype=jnp.int32)[None, :, None, None]
+    ) & dm[:, None]                                            # [M, H, S, C]
+    taken = sel.any(axis=2)                                    # [M, H, C]
+    slot_ids = jnp.arange(S, dtype=jnp.int32)[None, None, :, None]
+    idx = jnp.where(taken, (sel * slot_ids).sum(axis=2), -1)
+
+    def pack(x):
+        e = x.shape[1] // S
+        xr = x.reshape(spec.M, S, e, x.shape[-1])
+        f = (sel[:, :, :, None, :] * xr[:, None]).sum(axis=2)  # [M, H, e, C]
+        return f.reshape(spec.M, H * e, x.shape[-1]).astype(x.dtype)
+
+    return HeldSparse(idx=idx, msgs=jax.tree.map(pack, out))
+
+
+def _held_wins(spec: Spec, held: HeldSparse, fresh: Msg) -> Msg:
+    """Scatter the sparse held messages back over fresh traffic: a held
+    message wins a slot collision (the fresh one drops — legal per the
+    transport contract, etcdserver/raft.go:107-110)."""
+    S = spec.K * spec.M
+    H = HELD_SLOTS
+    sel = (
+        held.idx[:, :, None, :]
+        == jnp.arange(S, dtype=jnp.int32)[None, None, :, None]
+    ) & (held.idx >= 0)[:, :, None, :]                         # [M, H, S, C]
+    live = sel.any(axis=1)                                     # [M, S, C]
+
+    def un(xh, f):
+        e = f.shape[1] // S
+        xr = xh.reshape(spec.M, H, e, xh.shape[-1])
+        dense = (sel[:, :, :, None, :] * xr[:, :, None]).sum(axis=1)
+        dense = dense.reshape(spec.M, S * e, xh.shape[-1]).astype(f.dtype)
+        return jnp.where(_bc(spec, live, f), dense, f)
+
+    return jax.tree.map(un, held.msgs, fresh)
+
+
+def _merge_delayed(spec: Spec, out: Msg, held: HeldSparse,
+                   delay_mask) -> tuple[Msg, HeldSparse]:
     """Split this round's traffic by the delay mask and merge in messages
     held from the previous round. Message leaves are in the engine's FLAT
     storage form [from, K*to(*E), C]; `delay_mask` is [from, K*to, C]."""
-    dm = delay_mask
-    new_held = jax.tree.map(
-        lambda x: jnp.where(_bc(spec, dm, x), x, jnp.zeros_like(x)), out
-    )
-    new_held = new_held.replace(type=jnp.where(dm, out.type, 0))
-    fresh = out.replace(type=jnp.where(dm, 0, out.type))
+    new_held = _pack_held(spec, out, delay_mask)
+    fresh = out.replace(type=jnp.where(delay_mask, 0, out.type))
     return _held_wins(spec, held, fresh), new_held
 
 
@@ -136,13 +211,12 @@ def build_chaos_epoch(
     bookkeeping), which ignores the probability operands.
 
     `with_delay=False` removes the delay/reorder machinery AT TRACE TIME:
-    no Bernoulli delay draws, no held-buffer merge, and — decisively —
-    no held pytree in the scan carry. The held buffer is a full second
-    inbox (17 x [M, K*M, C] leaves) whose while-loop double-buffering
-    alone overflows HBM at the 1M-group configuration (measured:
-    17.01G/15.75G); the 1M chaos tier runs drop+partition mixes without
-    it, while delay/reorder coverage runs at <=524k. Callers pass
-    held=None and get None back.
+    no Bernoulli delay draws, no held-buffer merge, and no held pytree
+    in the scan carry. The held buffer is SPARSE (HeldSparse: HELD_SLOTS
+    packed messages per sender row) — the round-4 dense form was a full
+    second inbox whose double-buffering overflowed HBM at the 1M-group
+    configuration (measured 17.01G vs 15.75G), capping delay coverage
+    at 524k groups. Callers pass held=None and get None back.
     """
     round_fn = build_round(cfg, spec)
     M = spec.M
@@ -165,7 +239,10 @@ def build_chaos_epoch(
             # _merge_delayed), then run bare rounds with per-round checks.
             if with_delay:
                 inbox = _held_wins(spec, held, inbox)
-                held = jax.tree.map(jnp.zeros_like, held)
+                held = held.replace(
+                    idx=jnp.full_like(held.idx, -1),
+                    msgs=jax.tree.map(jnp.zeros_like, held.msgs),
+                )
             keep_all = jnp.ones((M, M, C), jnp.bool_)
 
             def heal_body(carry, r):
@@ -274,6 +351,7 @@ def run_chaos(
     delay_p: float = 0.05,
     partition_p: float = 0.1,
     propose: bool = True,
+    sync_dispatch: bool = False,
 ) -> dict:
     """The tester's round loop (tester/cluster_run.go): alternate fault
     epochs and heal epochs, then verify recovery — every group ends with
@@ -281,10 +359,11 @@ def run_chaos(
     stats; raises nothing (the caller asserts)."""
     state = init_fleet(spec, C, election_tick=cfg.election_tick, seed=seed)
     inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
-    # delay/reorder faults need a held buffer the size of a second inbox;
-    # delay_p=0 drops the whole machinery at trace time (1M-group tier)
+    # delay/reorder faults carry a SPARSE held buffer (HELD_SLOTS packed
+    # messages per sender row — see HeldSparse); delay_p=0 still drops
+    # the whole machinery at trace time
     with_delay = delay_p > 0
-    held = jax.tree.map(jnp.zeros_like, inbox) if with_delay else None
+    held = empty_held(spec, C, cfg.wire_int16) if with_delay else None
     key = jax.random.PRNGKey(seed)
     M = spec.M
     prop_len = jnp.zeros((M, C), jnp.int32)
@@ -303,6 +382,14 @@ def run_chaos(
     pp = jnp.float32(partition_p)
     z = jnp.float32(0.0)
 
+    def _sync(x):
+        # marginal-HBM probe (sync_dispatch): block between epoch
+        # dispatches so the donated buffers of the finished program are
+        # released before the next executable's workspace is allocated —
+        # async dispatch enqueues both and the allocator sees the sum
+        if sync_dispatch:
+            jax.block_until_ready(x)
+
     viol = zero_violations()
     commits = []
     done = 0
@@ -310,10 +397,12 @@ def run_chaos(
         state, inbox, held, key, viol, dc = chaos(
             state, inbox, held, key, prop_len, prop_data, viol, dp, lp, pp
         )
+        _sync(viol.multi_leader)
         done += epoch_len
         state, inbox, held, key, viol, dh = heal(
             state, inbox, held, key, prop_len, prop_data, viol, z, z, z
         )
+        _sync(viol.multi_leader)
         done += heal_len
         commits.append((int(dc), int(dh)))
 

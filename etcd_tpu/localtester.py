@@ -158,4 +158,8 @@ def main(argv=None) -> int:
 if __name__ == "__main__":
     import sys
 
+    from etcd_tpu.utils.cache import entrypoint_platform_setup
+
+    # host-tier tool: C=1 steps must never dispatch over a tunnel
+    entrypoint_platform_setup(force_cpu=True)
     sys.exit(main())

@@ -26,7 +26,7 @@ from etcd_tpu.harness.cluster import Cluster
 from etcd_tpu.models import confchange as ccdev
 from etcd_tpu.models.changer import Changer, Config as HostConfig, ConfChangeError
 from etcd_tpu.server.auth import AuthStore
-from etcd_tpu.server.lease import Lessor
+from etcd_tpu.server.lease import ErrLeaseNotFound, Lessor
 from etcd_tpu.server.mvcc import ErrCompacted, ErrFutureRev, KeyValue
 from etcd_tpu.server.version import (
     DowngradeInfo,
@@ -1145,6 +1145,14 @@ class EtcdCluster:
         for i, lid in enumerate(due):
             try:
                 self._propose({"kind": "lease_revoke", "id": lid})
+            except ErrLeaseNotFound:
+                # the revoke raced an earlier one (double expiry across
+                # ticks, or the previous leader's queued revoke landed
+                # first): already gone is SUCCESS for the expiry loop,
+                # like the reference's expired-lease retry loop treating
+                # ErrLeaseNotFound as completed (etcdserver/server.go
+                # revokeExpiredLeases)
+                continue
             except ServerError:
                 # retry this id and the rest next tick; their heap entries
                 # were popped by expired()

@@ -28,3 +28,21 @@ def configure_compile_cache(root: str | None = None) -> str:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     return cache
+
+
+def entrypoint_platform_setup(force_cpu: bool = False) -> None:
+    """The shared CLI-entrypoint preamble (etcdmain / chaos_lease /
+    localtester): honor JAX_PLATFORMS — this environment's
+    sitecustomize re-pins the accelerator platform at interpreter
+    start, overriding the env var, so it must be re-applied AFTER jax
+    imports — and point at the persistent compile cache. `force_cpu`
+    pins cpu outright for host-tier tools whose C=1 steps would
+    otherwise dispatch over an accelerator tunnel per op."""
+    import jax
+
+    if force_cpu:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        jax.config.update("jax_platforms", "cpu")
+    elif os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    configure_compile_cache()

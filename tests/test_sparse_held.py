@@ -1,0 +1,121 @@
+"""HeldSparse: the chaos tier's packed delay buffer must reproduce the
+dense held-buffer semantics exactly while under HELD_SLOTS messages per
+sender row — pack + scatter == the old full-inbox split/merge."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from etcd_tpu.harness.chaos import (
+    HELD_SLOTS,
+    _held_wins,
+    _merge_delayed,
+    _pack_held,
+    empty_held,
+)
+from etcd_tpu.models.engine import empty_inbox
+from etcd_tpu.types import Spec
+
+SPEC = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+C = 7
+S = SPEC.K * SPEC.M
+
+
+def _random_traffic(seed: int, live_per_row: int):
+    """A Msg in the engine's FLAT form with `live_per_row` nonempty
+    slots per sender row, random small field values."""
+    rng = np.random.default_rng(seed)
+    out = empty_inbox(SPEC, C, wire_int16=True)
+    leaves = {}
+    live = np.zeros((SPEC.M, S, C), bool)
+    for m in range(SPEC.M):
+        for c in range(C):
+            slots = rng.choice(S, size=live_per_row, replace=False)
+            live[m, slots, c] = True
+    for name in out.__dataclass_fields__:
+        x = np.asarray(getattr(out, name)).copy()
+        e = x.shape[1] // S
+        vals = rng.integers(1, 100, size=(SPEC.M, S, e, C))
+        x = np.where(
+            np.repeat(live, e, axis=1).reshape(x.shape),
+            vals.reshape(x.shape).astype(x.dtype), x)
+        leaves[name] = jnp.asarray(x)
+    out = out.replace(**leaves)
+    # type must be nonzero exactly on live slots (liveness follows type)
+    out = out.replace(type=jnp.where(jnp.asarray(live), out.type | 1,
+                                     0).astype(out.type.dtype))
+    return out, live
+
+
+def _dense_reference(spec, out, held_dense, dm):
+    """The round-4 dense split/merge, in numpy, as the oracle."""
+    def bc(mask, leaf):
+        if leaf.shape[1] != mask.shape[1]:
+            return np.repeat(mask, leaf.shape[1] // mask.shape[1], axis=1)
+        return mask
+
+    new_held = {}
+    fresh = {}
+    for name in out.__dataclass_fields__:
+        x = np.asarray(getattr(out, name))
+        new_held[name] = np.where(bc(dm, x), x, 0)
+        fresh[name] = x.copy()
+    fresh["type"] = np.where(dm, 0, np.asarray(out.type))
+    live = held_dense["type"] != 0
+    merged = {
+        name: np.where(bc(live, fresh[name]), held_dense[name],
+                       fresh[name])
+        for name in fresh
+    }
+    return merged, new_held
+
+
+def test_pack_scatter_matches_dense_semantics():
+    out, live = _random_traffic(0, live_per_row=2)
+    rng = np.random.default_rng(1)
+    dm = jnp.asarray(live & (rng.random((SPEC.M, S, C)) < 0.5))
+
+    held0 = empty_held(SPEC, C, wire_int16=True)
+    merged, new_held = _merge_delayed(SPEC, out, held0, dm)
+
+    zero_held = {name: np.zeros_like(np.asarray(getattr(out, name)))
+                 for name in out.__dataclass_fields__}
+    want_merged, want_held = _dense_reference(SPEC, out, zero_held,
+                                              np.asarray(dm))
+    for name in out.__dataclass_fields__:
+        assert np.array_equal(np.asarray(getattr(merged, name)),
+                              want_merged[name]), f"merged.{name}"
+
+    # round 2: fresh traffic + the previous round's held messages
+    out2, _ = _random_traffic(2, live_per_row=2)
+    no_delay = jnp.zeros((SPEC.M, S, C), bool)
+    merged2, _ = _merge_delayed(SPEC, out2, new_held, no_delay)
+    want_merged2, _ = _dense_reference(SPEC, out2, want_held, np.zeros(
+        (SPEC.M, S, C), bool))
+    for name in out.__dataclass_fields__:
+        assert np.array_equal(np.asarray(getattr(merged2, name)),
+                              want_merged2[name]), f"merged2.{name}"
+
+
+def test_overflow_drops_extras_only():
+    """More than HELD_SLOTS delayed in one row: the first HELD_SLOTS (in
+    slot order) are kept, the rest drop — nothing corrupts."""
+    out, live = _random_traffic(3, live_per_row=S)  # every slot live
+    dm = jnp.asarray(np.ones((SPEC.M, S, C), bool))  # delay everything
+    held = _pack_held(SPEC, out, dm)
+    idx = np.asarray(held.idx)
+    assert (idx[:, :HELD_SLOTS] == np.arange(HELD_SLOTS)[None, :, None]).all()
+    # scatter back: exactly the first HELD_SLOTS slots reappear
+    fresh = empty_inbox(SPEC, C, wire_int16=True)
+    merged = _held_wins(SPEC, held, fresh)
+    t = np.asarray(merged.type)
+    assert (t[:, :HELD_SLOTS] != 0).all()
+    assert (t[:, HELD_SLOTS:] == 0).all()
+
+
+def test_empty_held_is_inert():
+    out, _ = _random_traffic(4, live_per_row=2)
+    held = empty_held(SPEC, C, wire_int16=True)
+    merged = _held_wins(SPEC, held, out)
+    for name in out.__dataclass_fields__:
+        assert np.array_equal(np.asarray(getattr(merged, name)),
+                              np.asarray(getattr(out, name))), name
