@@ -35,6 +35,14 @@ from etcd_tpu.types import Spec
 from etcd_tpu.utils.config import RaftConfig
 
 CLUSTER_AXIS = "clusters"
+# 2-D mesh axis names (SURVEY §2.3): the clusters axis is sharded over
+# BOTH — outer splits ride DCN (slice/host boundaries), inner splits
+# ride ICI. Steady-state consensus needs zero collectives either way
+# (clusters are independent); only the invariant psum crosses the mesh,
+# and it reduces over ICI first, DCN last — exactly the hierarchy the
+# hardware wants.
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
 
 
 def make_fleet_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -49,13 +57,40 @@ def make_fleet_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (CLUSTER_AXIS,))
 
 
-def _last_axis_p(x) -> P:
+def make_fleet_mesh_2d(dcn: int, ici: int, devices=None) -> Mesh:
+    """2-D (DCN x ICI) mesh: `dcn` slices of `ici` devices each. The
+    fleet's clusters axis shards over the flattened (dcn, ici) grid —
+    device order follows jax.devices(), which enumerates ICI-connected
+    devices within a slice contiguously, so the inner axis is the
+    fast one. The reference's analog is many etcd processes bridged by
+    rafthttp over LAN/WAN; here the WAN tier is DCN between slices."""
+    if devices is None:
+        devices = jax.devices()
+    devices = devices[: dcn * ici]
+    if len(devices) < dcn * ici:
+        raise ValueError(
+            f"2-D mesh needs {dcn * ici} devices, have {len(devices)}")
+    import numpy as np
+
+    return Mesh(
+        np.asarray(devices).reshape(dcn, ici), (DCN_AXIS, ICI_AXIS)
+    )
+
+
+def _mesh_axes(mesh: Mesh) -> tuple:
+    """Every mesh axis shards the trailing clusters dim (1-D: clusters;
+    2-D: (dcn, ici) flattened — outer=DCN, inner=ICI)."""
+    names = tuple(mesh.axis_names)
+    return names if len(names) > 1 else names[0]
+
+
+def _last_axis_p(x, axes=CLUSTER_AXIS) -> P:
     """PartitionSpec sharding the trailing (clusters) axis of one leaf."""
-    return P(*([None] * (x.ndim - 1)), CLUSTER_AXIS)
+    return P(*([None] * (x.ndim - 1)), axes)
 
 
 def _leaf_sharding(mesh: Mesh, x) -> NamedSharding:
-    return NamedSharding(mesh, _last_axis_p(x))
+    return NamedSharding(mesh, _last_axis_p(x, _mesh_axes(mesh)))
 
 
 def shard_fleet(mesh: Mesh, *trees):
@@ -76,18 +111,19 @@ def _constrain(mesh: Mesh, tree):
     )
 
 
-def fleet_in_specs(cfg: RaftConfig, spec: Spec):
+def fleet_in_specs(cfg: RaftConfig, spec: Spec, mesh: Mesh | None = None):
     """Per-leaf PartitionSpecs (trailing axis on the mesh) for the 9 round
     args: (state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup,
     do_tick, keep_mask). Computed abstractly — no arrays materialised."""
+    axes = _mesh_axes(mesh) if mesh is not None else CLUSTER_AXIS
     st = jax.eval_shape(
         lambda: init_fleet(spec, 2, election_tick=cfg.election_tick)
     )
     ib = jax.eval_shape(lambda: empty_inbox(spec, 2))
-    state_specs = jax.tree.map(_last_axis_p, st)
-    inbox_specs = jax.tree.map(_last_axis_p, ib)
-    v2 = P(None, CLUSTER_AXIS)
-    v3 = P(None, None, CLUSTER_AXIS)
+    state_specs = jax.tree.map(lambda x: _last_axis_p(x, axes), st)
+    inbox_specs = jax.tree.map(lambda x: _last_axis_p(x, axes), ib)
+    v2 = P(None, axes)
+    v3 = P(None, None, axes)
     return (state_specs, inbox_specs, v2, v3, v3, v2, v2, v2, v3)
 
 
@@ -109,7 +145,7 @@ def build_shard_map_round(cfg: RaftConfig, spec: Spec, mesh: Mesh):
     locally. Composes with cross-shard collectives (psum of invariant
     violations etc.) and nested member-axis sharding later."""
     round_fn = build_round(cfg, spec)
-    in_specs = fleet_in_specs(cfg, spec)
+    in_specs = fleet_in_specs(cfg, spec, mesh)
 
     fn = shard_map(
         round_fn,
@@ -131,20 +167,32 @@ def build_global_invariants(cfg: RaftConfig, spec: Spec, mesh: Mesh):
     so the ICI cost is 3 scalars per check instead of the fleet."""
     from etcd_tpu.harness.chaos import check_invariants, zero_violations
 
+    axes = _mesh_axes(mesh)
     st = jax.eval_shape(
         lambda: init_fleet(spec, 2, election_tick=cfg.election_tick)
     )
-    state_specs = jax.tree.map(_last_axis_p, st)
+    state_specs = jax.tree.map(lambda x: _last_axis_p(x, axes), st)
+
+    def _reduce(x):
+        if isinstance(axes, str):
+            return jax.lax.psum(x, axes)
+        # genuinely hierarchical on the 2-D mesh: one psum per axis,
+        # inner (ICI) first so the cross-slice DCN hop reduces
+        # already-combined partials — a single psum over both names
+        # would lower to one flat all-reduce over the product group
+        for ax in reversed(axes):
+            x = jax.lax.psum(x, ax)
+        return x
 
     def local(state_shard, prev_commit_shard):
         v = check_invariants(state_shard, prev_commit_shard,
                              zero_violations())
-        return jax.tree.map(lambda x: jax.lax.psum(x, CLUSTER_AXIS), v)
+        return jax.tree.map(_reduce, v)
 
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(state_specs, P(None, CLUSTER_AXIS)),
+        in_specs=(state_specs, P(None, axes)),
         out_specs=jax.tree.map(lambda _: P(), zero_violations()),
         check_rep=False,
     )
@@ -183,7 +231,7 @@ def build_scan_rounds(cfg: RaftConfig, spec: Spec, mesh: Mesh | None, rounds: in
         # previous round's buffers, and at 1M groups they are GBs of HBM
         return jax.jit(many, donate_argnums=(0, 1))
     if use_shard_map:
-        in_specs = fleet_in_specs(cfg, spec)
+        in_specs = fleet_in_specs(cfg, spec, mesh)
         fn = shard_map(
             many,
             mesh=mesh,

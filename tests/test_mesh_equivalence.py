@@ -165,3 +165,53 @@ def test_global_invariants_psum_across_shards(runs):
     # commit regression is counted per node: claim every commit went up
     v3 = check(state, prev + 1)
     assert int(v3.commit_regress) == SPEC.M * C
+
+
+# ----------------------------------------------------- 2-D (DCN x ICI)
+
+def test_2d_mesh_form_is_bit_identical(runs):
+    """SURVEY §2.3's second axis: the same scenario through a
+    (dcn=2, ici=4) mesh — outer splits ride DCN, inner ICI — must be
+    bit-identical to the single-device run."""
+    from etcd_tpu.parallel.mesh import make_fleet_mesh_2d
+
+    mesh = make_fleet_mesh_2d(2, 4)
+    (s0, i0, c0) = runs[0]
+    s2, i2, c2 = _run(
+        build_shard_map_round(CFG, SPEC, mesh),
+        place=lambda s, i: shard_fleet(mesh, s, i),
+    )
+    for r, (a, b) in enumerate(zip(c0, c2)):
+        assert np.array_equal(a, b), f"commit diverged at round {r}"
+    for name in s0.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(s0, name)), np.asarray(getattr(s2, name))
+        ), f"state.{name}"
+    for name in i0.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(i0, name)), np.asarray(getattr(i2, name))
+        ), f"inbox.{name}"
+
+
+def test_2d_mesh_global_invariants_psum(runs):
+    """The invariant psum reduces over ICI then DCN and still catches
+    violations planted in different 2-D shards."""
+    from etcd_tpu.parallel.mesh import make_fleet_mesh_2d
+
+    mesh = make_fleet_mesh_2d(2, 4)
+    check = build_global_invariants(CFG, SPEC, mesh)
+    state, _, commits = runs[0]
+    prev = jnp.asarray(commits[-1])
+    v = check(*shard_fleet(mesh, state, prev))
+    assert int(v.multi_leader) == 0
+    role = np.array(state.role)
+    term = np.array(state.term)
+    # clusters 1 and 60 land on different DCN rows of the (2, 4) mesh
+    for c in (1, 60):
+        lead = int(np.argmax(role[:, c] == ROLE_LEADER))
+        other = (lead + 1) % SPEC.M
+        role[other, c] = ROLE_LEADER
+        term[other, c] = term[lead, c]
+    bad = state.replace(role=jnp.asarray(role), term=jnp.asarray(term))
+    v = check(*shard_fleet(mesh, bad, prev))
+    assert int(v.multi_leader) == 2
