@@ -436,4 +436,7 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
+    from etcd_tpu.utils.cache import entrypoint_platform_setup
+
+    entrypoint_platform_setup()
     sys.exit(main())

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Smoke tier (~5 min warm): the core-correctness subset to run between
+# models/raft.py edits, when the full suite's cold-compile cost
+# (~2h after any raft.py change invalidates the fleet-program cache)
+# would stall iteration. Covers: the raft state machines against the
+# reference datadriven goldens, the ring/quorum kernels, the trace-specialization
+# equivalence proofs (every perf rung), replication + election
+# scenarios. NOT a substitute for the full
+# suite before a commit milestone — wire façades, chaos, tools and e2e
+# only run there.
+cd "$(dirname "$0")"
+exec python -m pytest -q \
+  tests/test_datadriven_quorum.py \
+  tests/test_datadriven_confchange.py \
+  tests/test_paper.py \
+  tests/test_quorum.py \
+  tests/test_log.py \
+  tests/test_raftpb.py \
+  tests/test_confchange.py \
+  tests/test_election.py \
+  tests/test_replication.py \
+  tests/test_local_steps.py \
+  tests/test_deferred_emit.py \
+  tests/test_apply_specialization.py \
+  tests/test_sparse_held.py \
+  "$@"
