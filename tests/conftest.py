@@ -65,3 +65,26 @@ def pytest_configure(config):
         "markers",
         "smoke: fast core-correctness tier (-m smoke for quick "
         "iteration on models/raft.py edits)")
+
+
+def bootstrap_cert_cn_auth(call):
+    """Shared admin bootstrap for the cert-CN auth scenarios (test_tls
+    mtls fixture + the e2e subprocess variant): root with the root
+    role, alice scoped READWRITE to /app/*, auth enabled. `call` is a
+    RemoteClient.call-shaped callable."""
+    import base64
+
+    def b64(b):
+        return base64.b64encode(b).decode()
+
+    call("/v3/auth/user/add", {"name": "root", "password": "rpw"})
+    call("/v3/auth/role/add", {"name": "root"})
+    call("/v3/auth/user/grant", {"name": "root", "role": "root"})
+    call("/v3/auth/user/add", {"name": "alice", "password": "apw"})
+    call("/v3/auth/role/add", {"name": "app"})
+    call("/v3/auth/role/grant", {
+        "name": "app",
+        "perm": {"permType": "READWRITE", "key": b64(b"/app/"),
+                 "range_end": b64(b"/app0")}})
+    call("/v3/auth/user/grant", {"name": "alice", "role": "app"})
+    call("/v3/auth/enable", {})

@@ -196,3 +196,59 @@ def test_e2e_watch_over_wire(tmp_path):
         assert w.cancel()
     finally:
         _stop(proc)
+
+
+@pytest.mark.e2e
+def test_e2e_mtls_cert_cn_auth_survives_restart(tmp_path):
+    """The full security stack through real processes: spawn etcdmain
+    with explicit TLS flags (CA-signed server cert + required client
+    certs), enable auth and scope a user over the wire, authenticate
+    by client-cert CN alone, SIGKILL, restart — the auth state and TLS
+    config must survive the data dir round-trip."""
+    from etcd_tpu.client import RemoteClient, RemoteError
+    from etcd_tpu.transport import TLSInfo, generate_ca, issue_cert
+
+    certs = str(tmp_path / "certs")
+    ca = generate_ca(certs)
+    server = issue_cert(certs, ca, "server",
+                        hosts=["127.0.0.1", "localhost"])
+    alice = issue_cert(certs, ca, "alice")
+    data = str(tmp_path / "d")
+    port = _free_port()
+    tls_flags = ("--cert-file", server.cert_file,
+                 "--key-file", server.key_file,
+                 "--trusted-ca-file", ca.cert_file,
+                 "--client-cert-auth")
+    proc = _spawn(data, port, *tls_flags)
+    url = f"https://127.0.0.1:{port}"
+    alice_tls = TLSInfo(trusted_ca_file=ca.cert_file,
+                        client_cert_file=alice.cert_file,
+                        client_key_file=alice.key_file)
+    try:
+        _wait_healthy(url, proc, ctx=alice_tls.client_context())
+        from conftest import bootstrap_cert_cn_auth
+
+        cli = RemoteClient(url, tls=alice_tls)
+        bootstrap_cert_cn_auth(cli.call)
+        # cert-CN identity: no token, scoped to /app/*
+        cli.put(b"/app/sec", b"by-cert")
+        with pytest.raises(RemoteError):
+            cli.put(b"/outside", b"nope")
+        proc.kill()
+        proc.wait(timeout=15)
+    finally:
+        _stop(proc)
+    port2 = _free_port()
+    proc2 = _spawn(data, port2, *tls_flags)
+    url2 = f"https://127.0.0.1:{port2}"
+    try:
+        _wait_healthy(url2, proc2, ctx=alice_tls.client_context())
+        cli2 = RemoteClient(url2, tls=alice_tls)
+        # auth survived: still enabled, alice still scoped, data intact
+        assert cli2.get(b"/app/sec") == b"by-cert"
+        with pytest.raises(RemoteError):
+            cli2.put(b"/outside", b"still-denied")
+        cli2.put(b"/app/after", b"post-restart")
+        assert cli2.get(b"/app/after") == b"post-restart"
+    finally:
+        _stop(proc2)

@@ -145,22 +145,13 @@ def mtls(tmp_path_factory):
             cert_file=server.cert_file, key_file=server.key_file,
             trusted_ca_file=ca.cert_file, client_cert_auth=True)))
     # admin bootstrap over the wire (any CA-signed cert may connect)
+    from conftest import bootstrap_cert_cn_auth
+
     admin = RemoteClient(e.client_url, tls=TLSInfo(
         trusted_ca_file=ca.cert_file,
         client_cert_file=alice.cert_file,
         client_key_file=alice.key_file))
-    admin.call("/v3/auth/user/add", {"name": "root", "password": "rpw"})
-    admin.call("/v3/auth/role/add", {"name": "root"})
-    admin.call("/v3/auth/user/grant", {"name": "root", "role": "root"})
-    admin.call("/v3/auth/user/add", {"name": "alice", "password": "apw"})
-    admin.call("/v3/auth/role/add", {"name": "app"})
-    admin.call("/v3/auth/role/grant", {
-        "name": "app",
-        "perm": {"permType": "READWRITE",
-                 "key": RemoteClient._b64(b"/app/"),
-                 "range_end": RemoteClient._b64(b"/app0")}})
-    admin.call("/v3/auth/user/grant", {"name": "alice", "role": "app"})
-    admin.call("/v3/auth/enable", {})
+    bootstrap_cert_cn_auth(admin.call)
     yield {"e": e, "ca": ca, "alice": alice, "bob": bob}
     e.close()
 
