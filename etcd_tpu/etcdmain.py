@@ -60,6 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--proxy", choices=["off", "on"], default="off")
     p.add_argument("--proxy-endpoints", default="",
                    help="comma list of gateway URLs to proxy")
+    p.add_argument("--proxy-cacert", default=None,
+                   help="CA bundle for verifying HTTPS proxy upstreams")
     p.add_argument("--proxy-failure-wait", type=float, default=5.0)
     p.add_argument("--proxy-refresh-interval", type=float, default=30.0)
     return p
@@ -71,19 +73,27 @@ def run_proxy(args) -> int:
     import json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-    from etcd_tpu.httpproxy import Director, HTTPProxy, urllib_transport
+    from etcd_tpu.httpproxy import Director, HTTPProxy, make_urllib_transport
 
+    tls = None
+    if args.proxy_cacert:
+        from etcd_tpu.transport import TLSInfo
+
+        tls = TLSInfo(trusted_ca_file=args.proxy_cacert)
     urls = [u for u in args.proxy_endpoints.split(",") if u]
     if args.discovery and not urls:
         base, token = args.discovery.rsplit("/", 1)
         from etcd_tpu import clientv2, discovery
 
-        keys = clientv2.new(base).keys
+        # the discovery bootstrap dial trusts the same CA as the
+        # upstream forwards — an HTTPS discovery service behind a
+        # private CA must not fall back to the system trust store
+        keys = clientv2.new(base, tls=tls).keys
         cluster = discovery.Discovery(keys, token, "proxy").get_cluster()
         urls = [part.split("=", 1)[1] for part in cluster.split(",")]
     d = Director(lambda: urls, args.proxy_failure_wait,
                  args.proxy_refresh_interval)
-    proxy = HTTPProxy(d, urllib_transport)
+    proxy = HTTPProxy(d, make_urllib_transport(tls))
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"

@@ -104,22 +104,39 @@ class HTTPProxy:
                      f"{len(eps)} endpoint(s)"}, {}
 
 
-def urllib_transport(url: str, method: str, path: str,
-                     form: dict) -> tuple[int, dict, dict]:
-    """Deployment transport: forward over real HTTP to a gateway."""
-    import json
-    import urllib.error
-    import urllib.parse
-    import urllib.request
+def make_urllib_transport(tls):
+    """A transport bound to a client TLS config (transport.TLSInfo or
+    ssl.SSLContext) so the proxy can front HTTPS gateways — the
+    reference proxy dials upstream TLS from --peer/client cert flags
+    (etcdmain/gateway.go, proxy/httpproxy)."""
+    from etcd_tpu.transport import resolve_client_context
 
-    data = urllib.parse.urlencode(form).encode() if form else None
-    req = urllib.request.Request(
-        url + path, data=data, method=method,
-        headers={"Content-Type": "application/x-www-form-urlencoded"})
-    try:
-        with urllib.request.urlopen(req, timeout=5) as resp:
-            return resp.status, json.loads(resp.read()), dict(resp.headers)
-    except urllib.error.HTTPError as e:
-        # HTTP-level errors are valid proxy responses, not endpoint
-        # failures (reverse.go forwards them through)
-        return e.code, json.loads(e.read()), dict(e.headers)
+    ctx = resolve_client_context(tls)
+
+    def transport(url: str, method: str, path: str,
+                  form: dict) -> tuple[int, dict, dict]:
+        import json
+        import urllib.error
+        import urllib.parse
+        import urllib.request
+
+        data = urllib.parse.urlencode(form).encode() if form else None
+        req = urllib.request.Request(
+            url + path, data=data, method=method,
+            headers={"Content-Type": "application/x-www-form-urlencoded"})
+        try:
+            with urllib.request.urlopen(req, timeout=5,
+                                        context=ctx) as resp:
+                return (resp.status, json.loads(resp.read()),
+                        dict(resp.headers))
+        except urllib.error.HTTPError as e:
+            # HTTP-level errors are valid proxy responses, not endpoint
+            # failures (reverse.go forwards them through)
+            return e.code, json.loads(e.read()), dict(e.headers)
+
+    return transport
+
+
+# Back-compat plain-HTTP transport (the pre-TLS symbol), built ONCE at
+# module load — not per request.
+urllib_transport = make_urllib_transport(None)

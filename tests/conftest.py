@@ -72,11 +72,9 @@ def bootstrap_cert_cn_auth(call):
     mtls fixture + the e2e subprocess variant): root with the root
     role, alice scoped READWRITE to /app/*, auth enabled. `call` is a
     RemoteClient.call-shaped callable."""
-    import base64
+    from etcd_tpu.client import RemoteClient
 
-    def b64(b):
-        return base64.b64encode(b).decode()
-
+    b64 = RemoteClient._b64
     call("/v3/auth/user/add", {"name": "root", "password": "rpw"})
     call("/v3/auth/role/add", {"name": "root"})
     call("/v3/auth/user/grant", {"name": "root", "role": "root"})

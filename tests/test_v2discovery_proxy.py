@@ -181,3 +181,30 @@ def test_director_keeps_endpoint_state_across_refresh():
     d.endpoints()[0].failed(100.0)
     clk.t += 2  # refresh happens, but 'a' stays marked failed
     assert [e.url for e in d.endpoints()] == ["http://b"]
+
+
+def test_httpproxy_fronts_https_upstream(tmp_path):
+    """make_urllib_transport(TLSInfo): the v2 proxy forwards to an
+    HTTPS gateway with CA verification (the reference proxy's TLS
+    upstream dial); without the CA the endpoint is marked failed."""
+    from etcd_tpu.embed import Config, start_etcd
+    from etcd_tpu.httpproxy import make_urllib_transport
+    from etcd_tpu.transport import TLSInfo
+
+    e = start_etcd(Config(cluster_size=1, data_dir=str(tmp_path / "d"),
+                          client_auto_tls=True, auto_tick=False))
+    try:
+        d = Director(lambda: [e.client_url], 5.0, 30.0)
+        tls = TLSInfo(trusted_ca_file=e.client_tls.cert_file)
+        p = HTTPProxy(d, make_urllib_transport(tls))
+        st, body, _ = p.handle("PUT", "/v2/keys/px/a", {"value": "v"})
+        assert st == 201, body
+        st, body, _ = p.handle("GET", "/v2/keys/px/a", {})
+        assert st == 200 and body["node"]["value"] == "v"
+        # no CA: handshake fails, the director marks the endpoint down
+        d2 = Director(lambda: [e.client_url], 5.0, 30.0)
+        p2 = HTTPProxy(d2, make_urllib_transport(None))
+        st, body, _ = p2.handle("GET", "/v2/keys/px/a", {})
+        assert st == 503
+    finally:
+        e.close()
