@@ -1,5 +1,5 @@
 #!/bin/bash
-# Smoke tier (~5 min warm): the core-correctness subset to run between
+# Smoke tier (~15 min warm on this 1-core VM; measured): the core-correctness subset to run between
 # models/raft.py edits, when the full suite's cold-compile cost
 # (~2h after any raft.py change invalidates the fleet-program cache)
 # would stall iteration. Covers: the raft state machines against the
