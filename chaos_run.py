@@ -2,10 +2,16 @@
 
 Runs the functional chaos loop (etcd_tpu/harness/chaos.py) at
 CHAOS_C groups x CHAOS_ROUNDS rounds with randomized drop/delay/partition
-faults and on-device safety checkers, then prints ONE JSON line with the
-violation counts and liveness stats. Evidence files: CHAOS_r*.json.
+(and, with CHAOS_CRASH > 0, crash–restart) faults and on-device safety
+checkers, then prints ONE JSON line with the violation counts and
+liveness stats. Evidence files: CHAOS_r*.json.
 
 Usage: CHAOS_C=1000000 CHAOS_ROUNDS=200 python chaos_run.py
+Crash tier: CHAOS_C=262144 CHAOS_CRASH=0.01 python chaos_run.py
+  (CHAOS_DOWN sets the outage length in rounds; CHAOS_DURABILITY=none
+  selects the deliberately-broken persist-nothing model, which MUST
+  trip the leader-completeness checker — useful to prove the checker
+  is live at scale.)
 """
 from __future__ import annotations
 
@@ -32,9 +38,9 @@ configure_compile_cache(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main() -> int:
-    from etcd_tpu.harness.chaos import run_chaos
+    from etcd_tpu.harness.chaos import run_chaos, summarize_chaos
     from etcd_tpu.types import Spec
-    from etcd_tpu.utils.config import RaftConfig
+    from etcd_tpu.utils.config import CrashConfig, RaftConfig
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
@@ -65,6 +71,13 @@ def main() -> int:
                      wire_int16=wire16, fleet_chunks=chunks)
 
     epoch_len, heal_len = 50, 25
+    # crash–restart faults (CrashConfig durability model): off by default
+    # so the legacy network-fault evidence runs stay bit-identical
+    crash_p = float(os.environ.get("CHAOS_CRASH", "0"))
+    crash_cfg = CrashConfig(
+        down_rounds=int(os.environ.get("CHAOS_DOWN", "3")),
+        durability=os.environ.get("CHAOS_DURABILITY", "stable"),
+    ) if crash_p > 0 else None
     t0 = time.perf_counter()
     rep = run_chaos(
         spec, cfg, C=C, rounds=rounds, epoch_len=epoch_len, heal_len=heal_len,
@@ -72,36 +85,17 @@ def main() -> int:
         drop_p=float(os.environ.get("CHAOS_DROP", "0.02")),
         delay_p=float(os.environ.get("CHAOS_DELAY", "0.05")),
         partition_p=float(os.environ.get("CHAOS_PART", "0.1")),
+        crash_p=crash_p, crash=crash_cfg,
         sync_dispatch=os.environ.get("CHAOS_SYNC", "0") != "0",
     )
     rep["elapsed_s"] = round(time.perf_counter() - t0, 1)
     rep["platform"] = platform
-    rep["safe"] = (
-        rep["multi_leader"] == 0
-        and rep["hash_mismatch"] == 0
-        and rep["commit_regress"] == 0
-    )
-    rep["recovered"] = (
-        rep["groups_with_leader_after_heal"] == rep["groups"]
-        and rep["heal_commits_last_epoch"] > 0
-    )
-
-    # liveness floor DURING fault epochs (VERDICT r3 Weak #4: heal-time
-    # recovery alone would let a wedge-everything regression pass). The
-    # floor is a fraction of the fault-free throughput (1 commit/group/
-    # round), defaulted for the standard mix; harsher mixes must set
-    # CHAOS_LIVENESS_FRAC consciously (heavy partitions legally starve
-    # minority sides).
-    faulted = sum(dc for dc, _ in rep["epoch_commits"])
-    # fault epochs = the while-loop iterations of run_chaos (epoch_len +
-    # heal_len rounds per iteration); WaitHealth extensions append (0, dh)
-    # rows that are NOT fault epochs and must not inflate the floor
-    faulted_rounds = -(-rounds // (epoch_len + heal_len)) * epoch_len
-    frac = float(os.environ.get("CHAOS_LIVENESS_FRAC", "0.2"))
-    floor = int(frac * C * faulted_rounds)
-    rep["faulted_commits"] = faulted
-    rep["faulted_liveness_floor"] = floor
-    rep["lively"] = faulted >= floor
+    # safety/recovery/liveness gates (harness/chaos.py summarize_chaos —
+    # the same pure function the tests drive)
+    rep.update(summarize_chaos(
+        rep, rounds=rounds, epoch_len=epoch_len, heal_len=heal_len,
+        liveness_frac=float(os.environ.get("CHAOS_LIVENESS_FRAC", "0.2")),
+    ))
 
     # host-layer lease chaos (tester/stresser_lease.go +
     # checker_lease_expire.go analogs): stress/expire leases through

@@ -3,9 +3,10 @@
 The reference's functional tester (tests/functional/tester/cluster.go:43-65)
 loops rounds of inject -> stress -> recover -> check over a live cluster,
 with fault cases like BLACKHOLE/DELAY_PEER_PORT_TX_RX (rpcpb enum) injected
-by an L4 proxy (pkg/proxy/server.go:92-127) and a KV_HASH checker
-(tester/checker_kv_hash.go) asserting every member converges to the same
-state hash.
+by an L4 proxy (pkg/proxy/server.go:92-127), SIGTERM/SIGKILL process kills
+with restart (tester/case_sigterm.go + the snapshot cases) and a KV_HASH
+checker (tester/checker_kv_hash.go) asserting every member converges to the
+same state hash.
 
 The TPU-native equivalent runs the whole loop ON DEVICE at fleet scale:
 
@@ -16,13 +17,33 @@ The TPU-native equivalent runs the whole loop ON DEVICE at fleet scale:
     messages divert into a held buffer with probability p and deliver a
     round late — arriving after younger messages, which exercises
     reordering;
+  * crash–restart faults (the SIGKILL cases): per-round Bernoulli crash
+    masks wipe each hit node's volatile state and in-flight traffic,
+    keeping only its modeled durable state — HardState term/vote, the
+    snapshot, and the log prefix up to a per-node ``stable`` index that
+    lags last_index by one lockstep round (fsync lag; entries past it are
+    LOST). The node stays down for a configurable number of rounds, then
+    restarts as a follower with a fresh randomized election timeout and
+    re-derives applied state by replaying its durable log from the
+    snapshot. See utils/config.py CrashConfig and the durability
+    classification table in models/state.py.
   * checkers, evaluated every round as tensor reductions and accumulated
     as violation counters so only a handful of scalars ever cross to the
     host:
       - election safety: at most one leader per (group, term);
       - state-machine safety (KV_HASH): equal applied index => equal
         applied hash, for every member pair;
-      - commit monotonicity: no node's commit index ever regresses.
+      - commit monotonicity: no node's commit index ever regresses
+        (crash rounds are exempt for the crashed nodes — commit-only
+        advances are never fsync'd, so a restart legally regresses it);
+      - leader completeness: no index the group has ever committed stops
+        being durably held by a quorum (a crash that dropped holders
+        below quorum could elect a leader missing committed entries);
+      - log matching across restart: every member that can still read
+        the group's minimum commit index agrees on its term;
+      - term monotonicity on the persisted HardState: term never moves
+        backwards, crash or not (term/vote changes fsync before any
+        message reflecting them is sent).
 
 Everything (fault sampling, stepping, checking) lives in one lax.scan —
 no host round-trips during a chaos epoch.
@@ -35,10 +56,21 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
+from etcd_tpu.models.engine import (
+    build_round,
+    crash_restart_fleet,
+    empty_inbox,
+    init_fleet,
+    wipe_crashed_traffic,
+)
+from etcd_tpu.models.metrics import (
+    CrashMetrics,
+    crash_metrics_report,
+    zero_crash_metrics,
+)
 from etcd_tpu.models.state import NodeState
 from etcd_tpu.types import Msg, ROLE_LEADER, Spec
-from etcd_tpu.utils.config import RaftConfig
+from etcd_tpu.utils.config import CrashConfig, RaftConfig
 
 
 class Violations(struct.PyTreeNode):
@@ -47,16 +79,27 @@ class Violations(struct.PyTreeNode):
     multi_leader: jnp.ndarray     # >1 leader at one (group, term)
     hash_mismatch: jnp.ndarray    # equal applied, different hash
     commit_regress: jnp.ndarray   # commit index moved backwards
+    # crash-recovery invariants (checked when crash faults are enabled;
+    # stay 0 in the network-only programs, which don't evaluate them)
+    lost_commit: jnp.ndarray      # committed index held by < quorum
+    log_divergence: jnp.ndarray   # term disagreement at the commit frontier
+    term_regress: jnp.ndarray     # persisted HardState term moved backwards
 
 
 def zero_violations() -> Violations:
     z = jnp.int32(0)
-    return Violations(multi_leader=z, hash_mismatch=z, commit_regress=z)
+    return Violations(multi_leader=z, hash_mismatch=z, commit_regress=z,
+                      lost_commit=z, log_divergence=z, term_regress=z)
 
 
 def check_invariants(state: NodeState, prev_commit: jnp.ndarray,
-                     viol: Violations) -> Violations:
-    """One round's checker pass: pure reductions over [M, C] leaves."""
+                     viol: Violations, exempt=None) -> Violations:
+    """One round's checker pass: pure reductions over [M, C] leaves.
+
+    ``exempt`` ([M, C] bool or None) excludes nodes from the
+    commit-monotonicity check — the crash tier passes this round's crash
+    mask, because capping the persisted commit at the durable log is a
+    legal regression (MustSync never covers commit-only advances)."""
     M = state.role.shape[0]
     is_lead = state.role == ROLE_LEADER            # [M, C]
     term = state.term
@@ -66,11 +109,89 @@ def check_invariants(state: NodeState, prev_commit: jnp.ndarray,
     same_applied = state.applied[iu] == state.applied[ju]
     diff_hash = state.applied_hash[iu] != state.applied_hash[ju]
     regress = state.commit < prev_commit
-    return Violations(
+    if exempt is not None:
+        regress = regress & ~exempt
+    return viol.replace(
         multi_leader=viol.multi_leader + both_lead.sum().astype(jnp.int32),
         hash_mismatch=viol.hash_mismatch
         + (same_applied & diff_hash).sum().astype(jnp.int32),
         commit_regress=viol.commit_regress + regress.sum().astype(jnp.int32),
+    )
+
+
+def check_recovery_invariants(
+    spec: Spec, state: NodeState, watermark: jnp.ndarray,
+    prev_term: jnp.ndarray, viol: Violations, quorum: int,
+) -> tuple[Violations, jnp.ndarray]:
+    """Crash-recovery checkers (ISSUE 3), as per-round tensor reductions.
+
+    ``watermark`` [C] is the running max index each group has ever
+    committed; the updated watermark is returned for the scan carry.
+    ``quorum`` is the static majority of the full member set — the crash
+    tier runs fixed all-voter fleets (membership-change chaos is a
+    ROADMAP open item).
+    """
+    M = spec.M
+    # term monotonicity on the persisted HardState: term/vote fsync
+    # before any message reflecting them leaves the node, so nothing —
+    # crash included — may move a node's term backwards
+    t_reg = (state.term < prev_term).sum().astype(jnp.int32)
+
+    # leader completeness: every index the group has ever committed must
+    # remain durably held by >= quorum members (last_index covers
+    # snapshot holders: last_index >= snap_index always), or an election
+    # among the non-holders could erase a committed entry
+    wm = jnp.maximum(watermark, state.commit.max(axis=0))        # [C]
+    holders = (state.last_index >= wm[None, :]).sum(axis=0)      # [C]
+    lost = ((holders < quorum) & (wm > 0)).sum().astype(jnp.int32)
+
+    # log matching across restart, probed at the group's committed
+    # frontier: all members that can still read min-commit agree on its
+    # term. Members compacted past it abstain; snapshot-boundary holders
+    # answer with snap_term (same rule as ops/log.py term_at).
+    mc = state.commit.min(axis=0)                                 # [C]
+    L = state.log_term.shape[1]
+    oh = jnp.arange(L, dtype=jnp.int32)[:, None] == (mc - 1) % L  # [L, C]
+    t_ring = (state.log_term * oh[None, :, :]).sum(axis=1)        # [M, C]
+    t_mc = jnp.where(mc[None, :] == state.snap_index, state.snap_term, t_ring)
+    can_read = (mc[None, :] >= state.snap_index) & (mc[None, :] > 0)
+    iu, ju = jnp.triu_indices(M, k=1)
+    diverged = (t_mc[iu] != t_mc[ju]) & can_read[iu] & can_read[ju]
+
+    return viol.replace(
+        term_regress=viol.term_regress + t_reg,
+        lost_commit=viol.lost_commit + lost,
+        log_divergence=viol.log_divergence
+        + diverged.sum().astype(jnp.int32),
+    ), wm
+
+
+class CrashState(struct.PyTreeNode):
+    """Scan-carried crash bookkeeping (all leaves small next to the log).
+
+    ``stable`` is each node's durable log floor: its last_index as of the
+    top of the PREVIOUS round. The one-round lag is the modeled fsync
+    latency, and it is exactly safe: an acknowledgement emitted in round
+    r covers entries appended by end of round r and delivers in round
+    r+1, so by the time any peer has observed the ack (top of round r+2)
+    those entries are at or below the crash floor — and a crash at round
+    r+1 wipes the still-in-flight ack together with the entries.
+    """
+
+    stable: jnp.ndarray     # [M, C] i32 durable log floor
+    down: jnp.ndarray       # [M, C] i32 rounds of down-time left (0 = up)
+    watermark: jnp.ndarray  # [C] i32 running max committed index
+    prev_term: jnp.ndarray  # [M, C] i32 term-monotonicity baseline
+    metrics: CrashMetrics
+
+
+def empty_crash_state(state: NodeState) -> CrashState:
+    return CrashState(
+        stable=state.last_index,
+        down=jnp.zeros_like(state.last_index),
+        watermark=state.commit.max(axis=0),
+        prev_term=state.term,
+        metrics=zero_crash_metrics(),
     )
 
 
@@ -190,16 +311,22 @@ def build_chaos_epoch(
     partition_period: int = 25,
     tick: bool = True,
     with_delay: bool = True,
+    with_crash: bool = False,
 ):
     """One jitted chaos epoch: `rounds` lockstep rounds of faulted traffic
     with per-round invariant checks.
 
-    Returns fn(state, inbox, held, key, prop_len, prop_data, viol,
-    drop_p, delay_p, partition_p) -> (state, inbox, held, key, viol,
-    commits_delta). The fault probabilities are RUNTIME operands, not
-    closure constants — one traced program serves every fault mix (a
-    full trace costs ~40s of single-core time; the suite's three chaos
-    configurations used to pay it three times over). The regression
+    Returns fn(state, inbox, held, crash, key, prop_len, prop_data, viol,
+    drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log) ->
+    (state, inbox, held, crash, key, viol, commits_delta). The fault
+    probabilities are RUNTIME operands, not closure constants — one
+    traced program serves every fault mix (a full trace costs ~40s of
+    single-core time; the suite's chaos configurations used to pay it
+    once per mix). The crash knobs ride the same way: ``crash_p``
+    (per-node per-round kill probability), ``down_rounds`` (outage
+    length) and ``keep_log`` (False = the broken persist-nothing
+    durability model) are operands, so the honest and deliberately-broken
+    models share one trace. The regression
     baseline (prev_commit) starts at the entry state's own commit —
     nothing moves between epochs, so passing it across the boundary
     would merely alias a leaf of the donated state.
@@ -217,12 +344,23 @@ def build_chaos_epoch(
     second inbox whose double-buffering overflowed HBM at the 1M-group
     configuration (measured 17.01G vs 15.75G), capping delay coverage
     at 524k groups. Callers pass held=None and get None back.
+
+    `with_crash=False` removes the crash–restart machinery AT TRACE TIME
+    the same way (no crash sampling, no CrashState in the carry, no
+    recovery checkers — the legacy network-fault programs are
+    structurally unchanged). Callers pass crash=None and get None back.
+    With it on, the heal program still runs down-timers to completion
+    and keeps checking the recovery invariants; only fault epochs sample
+    new crashes.
     """
     round_fn = build_round(cfg, spec)
     M = spec.M
+    # static majority of the full member set — crash chaos runs fixed
+    # all-voter fleets (see check_recovery_invariants)
+    quorum = M // 2 + 1
 
-    def epoch(state, inbox, held, key, prop_len, prop_data, viol,
-              drop_p, delay_p, partition_p):
+    def epoch(state, inbox, held, crash, key, prop_len, prop_data, viol,
+              drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log):
         prev_commit = state.commit
         C = state.term.shape[-1]
         zp = jnp.zeros((M, spec.E, C), jnp.int32)
@@ -231,6 +369,72 @@ def build_chaos_epoch(
         do_tick = jnp.full((M, C), tick, jnp.bool_)
         commit0 = state.commit.sum()
         key, pkey = jax.random.split(key)
+
+        def pre_round(state, inbox, held, crash, key, sample):
+            """Top-of-round crash bookkeeping: run down-timers, optionally
+            kill fresh nodes (volatile-state wipe to the durable floor),
+            silence all down hosts' in-flight traffic, refresh the floor.
+            Returns (..., crashed_now, alive); no-op when crashes are
+            compiled out."""
+            if not with_crash:
+                return state, inbox, held, crash, key, None, None
+            was_down = crash.down > 0
+            down = jnp.maximum(crash.down - 1, 0)
+            restarted = (was_down & (down == 0)).sum().astype(jnp.int32)
+            if sample:
+                key, ck, tk = jax.random.split(key, 3)
+                hit = jax.random.bernoulli(ck, crash_p, (M, C)) & (down == 0)
+                # restart draws a fresh randomized election timeout in
+                # [T, 2T), same distribution as boot (models/state.py)
+                rand_to = cfg.election_tick + jax.random.randint(
+                    tk, (M, C), 0, cfg.election_tick, dtype=jnp.int32)
+                state, lost = crash_restart_fleet(
+                    spec, state, hit, crash.stable, rand_to,
+                    keep_log=keep_log)
+                down = jnp.where(hit, down_rounds, down)
+            else:
+                hit = jnp.zeros((M, C), jnp.bool_)
+                lost = jnp.int32(0)
+            # a down host's in-flight traffic is dead every round it is
+            # down: the FROM wipe makes fsync-lag entry loss safe (the
+            # unsynced entries' acks die with it), the TO wipe models its
+            # dead kernel buffers, and re-wiping while down also kills
+            # held-buffer messages that resurface mid-outage
+            inbox = wipe_crashed_traffic(spec, inbox, down > 0)
+            if sample and with_delay:
+                # messages a crashed sender emitted in its lost round may
+                # also sit delayed in the held buffer — same pre-fsync
+                # sends, same wipe
+                held = held.replace(
+                    idx=jnp.where(hit[:, None, :], -1, held.idx))
+            m = crash.metrics
+            crash = crash.replace(
+                stable=state.last_index,
+                down=down,
+                metrics=m.replace(
+                    crashes_injected=m.crashes_injected
+                    + hit.sum().astype(jnp.int32),
+                    entries_lost_fsync=m.entries_lost_fsync + lost,
+                    restarts_completed=m.restarts_completed + restarted,
+                ),
+            )
+            return state, inbox, held, crash, key, hit, down == 0
+
+        def mask_down(keep, pl, dt, alive):
+            """Down nodes neither exchange traffic, tick, nor propose."""
+            if not with_crash:
+                return keep, pl, dt
+            return (keep & alive[:, None, :] & alive[None, :, :],
+                    jnp.where(alive, pl, 0), dt & alive)
+
+        def post_checks(state, prev_commit, crash, viol, hit):
+            viol = check_invariants(state, prev_commit, viol, exempt=hit)
+            if with_crash:
+                viol, wm = check_recovery_invariants(
+                    spec, state, crash.watermark, crash.prev_term, viol,
+                    quorum)
+                crash = crash.replace(watermark=wm, prev_term=state.term)
+            return crash, viol
 
         if faultless:
             # heal program: no fault sampling, no delay bookkeeping. Drain
@@ -246,19 +450,22 @@ def build_chaos_epoch(
             keep_all = jnp.ones((M, M, C), jnp.bool_)
 
             def heal_body(carry, r):
-                state, inbox, viol, prev_commit = carry
+                state, inbox, crash, viol, prev_commit = carry
+                state, inbox, _, crash, _, hit, alive = pre_round(
+                    state, inbox, None, crash, None, False)
+                keep, pl, dt = mask_down(keep_all, prop_len, do_tick, alive)
                 state, out = round_fn(
-                    state, inbox, prop_len, prop_data, zp, z2, no,
-                    do_tick, keep_all
+                    state, inbox, pl, prop_data, zp, z2, no, dt, keep
                 )
-                viol = check_invariants(state, prev_commit, viol)
-                return (state, out, viol, state.commit), None
+                crash, viol = post_checks(state, prev_commit, crash, viol,
+                                          hit)
+                return (state, out, crash, viol, state.commit), None
 
-            (state, inbox, viol, prev_commit), _ = jax.lax.scan(
-                heal_body, (state, inbox, viol, prev_commit),
+            (state, inbox, crash, viol, prev_commit), _ = jax.lax.scan(
+                heal_body, (state, inbox, crash, viol, prev_commit),
                 jnp.arange(rounds, dtype=jnp.int32),
             )
-            return (state, inbox, held, key, viol,
+            return (state, inbox, held, crash, key, viol,
                     state.commit.sum() - commit0)
 
         def sample_keep(key, r):
@@ -279,46 +486,57 @@ def build_chaos_epoch(
 
         if with_delay:
             def body(carry, r):
-                state, inbox, held, key, viol, prev_commit = carry
+                state, inbox, held, crash, key, viol, prev_commit = carry
+                state, inbox, held, crash, key, hit, alive = pre_round(
+                    state, inbox, held, crash, key, True)
                 key, kl, keep = sample_keep(key, r)
+                keep, pl, dt = mask_down(keep, prop_len, do_tick, alive)
                 state, out = round_fn(
-                    state, inbox, prop_len, prop_data, zp, z2, no,
-                    do_tick, keep
+                    state, inbox, pl, prop_data, zp, z2, no, dt, keep
                 )
                 delay = jax.random.bernoulli(
                     kl, delay_p, (M, spec.K * M, C)
                 ) & (out.type != 0)
                 nxt, held2 = _merge_delayed(spec, out, held, delay)
-                viol = check_invariants(state, prev_commit, viol)
-                return (state, nxt, held2, key, viol, state.commit), None
+                crash, viol = post_checks(state, prev_commit, crash, viol,
+                                          hit)
+                return (state, nxt, held2, crash, key, viol,
+                        state.commit), None
 
-            (state, inbox, held, key, viol, prev_commit), _ = jax.lax.scan(
-                body, (state, inbox, held, key, viol, prev_commit),
-                jnp.arange(rounds, dtype=jnp.int32),
-            )
+            (state, inbox, held, crash, key, viol, prev_commit), _ = \
+                jax.lax.scan(
+                    body,
+                    (state, inbox, held, crash, key, viol, prev_commit),
+                    jnp.arange(rounds, dtype=jnp.int32),
+                )
         else:
             def body(carry, r):
-                state, inbox, key, viol, prev_commit = carry
+                state, inbox, crash, key, viol, prev_commit = carry
+                state, inbox, _, crash, key, hit, alive = pre_round(
+                    state, inbox, None, crash, key, True)
                 key, _, keep = sample_keep(key, r)
+                keep, pl, dt = mask_down(keep, prop_len, do_tick, alive)
                 state, out = round_fn(
-                    state, inbox, prop_len, prop_data, zp, z2, no,
-                    do_tick, keep
+                    state, inbox, pl, prop_data, zp, z2, no, dt, keep
                 )
-                viol = check_invariants(state, prev_commit, viol)
-                return (state, out, key, viol, state.commit), None
+                crash, viol = post_checks(state, prev_commit, crash, viol,
+                                          hit)
+                return (state, out, crash, key, viol, state.commit), None
 
-            (state, inbox, key, viol, prev_commit), _ = jax.lax.scan(
-                body, (state, inbox, key, viol, prev_commit),
+            (state, inbox, crash, key, viol, prev_commit), _ = jax.lax.scan(
+                body, (state, inbox, crash, key, viol, prev_commit),
                 jnp.arange(rounds, dtype=jnp.int32),
             )
-        return state, inbox, held, key, viol, state.commit.sum() - commit0
+        return state, inbox, held, crash, key, viol, \
+            state.commit.sum() - commit0
 
     return epoch
 
 
 @functools.lru_cache(maxsize=32)
 def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
-                   faultless: bool, with_delay: bool = True):
+                   faultless: bool, with_delay: bool = True,
+                   with_crash: bool = False):
     """One jitted epoch program per (cfg, spec, rounds, structure),
     shared across every run_chaos call and fault mix (probabilities are
     operands). Donation of the fleet-sized carries (state/inbox/held) is
@@ -328,13 +546,14 @@ def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
     if jax.default_backend() != "cpu":
         # held (arg 2) is None (no buffers) when the delay machinery is
         # compiled out — donating it is at best a no-op and has crashed
-        # the tunneled TPU worker at fleet scale
+        # the tunneled TPU worker at fleet scale. CrashState (arg 3) is
+        # a few [M, C] planes — not worth the same None-donation hazard.
         donate = (0, 1, 2) if with_delay else (0, 1)
     else:
         donate = ()
     return jax.jit(
         build_chaos_epoch(cfg, spec, rounds, faultless=faultless,
-                          with_delay=with_delay),
+                          with_delay=with_delay, with_crash=with_crash),
         donate_argnums=donate,
     )
 
@@ -350,13 +569,29 @@ def run_chaos(
     drop_p: float = 0.02,
     delay_p: float = 0.05,
     partition_p: float = 0.1,
+    crash_p: float = 0.0,
+    crash: CrashConfig | None = None,
     propose: bool = True,
     sync_dispatch: bool = False,
 ) -> dict:
     """The tester's round loop (tester/cluster_run.go): alternate fault
     epochs and heal epochs, then verify recovery — every group ends with
     a leader and fresh commits. Returns the violation counts + liveness
-    stats; raises nothing (the caller asserts)."""
+    stats; raises nothing (the caller asserts).
+
+    ``crash_p`` > 0 enables crash–restart faults (per-node per-round kill
+    probability during fault epochs) with the durability model described
+    by ``crash`` (default CrashConfig: 3-round outages, fsync-lag entry
+    loss); crash_p=0 compiles the whole crash machinery out.
+    """
+    with_crash = crash_p > 0
+    if with_crash and spec.M < 2:
+        # a singleton commits its own append in the same round, before
+        # the modeled fsync completes — the one shape where losing the
+        # unsynced suffix can erase a committed entry without any
+        # observable ack to wipe
+        raise ValueError("crash faults require M >= 2 (fsync-lag model)")
+    crash_cfg = (crash or CrashConfig()) if with_crash else None
     state = init_fleet(spec, C, election_tick=cfg.election_tick, seed=seed)
     inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
     # delay/reorder faults carry a SPARSE held buffer (HELD_SLOTS packed
@@ -364,6 +599,7 @@ def run_chaos(
     # the whole machinery at trace time
     with_delay = delay_p > 0
     held = empty_held(spec, C, cfg.wire_int16) if with_delay else None
+    crash_state = empty_crash_state(state) if with_crash else None
     key = jax.random.PRNGKey(seed)
     M = spec.M
     prop_len = jnp.zeros((M, C), jnp.int32)
@@ -375,11 +611,15 @@ def run_chaos(
         prop_len = prop_len.at[0].set(1)
         prop_data = prop_data.at[0, 0].set(7)
 
-    chaos = _epoch_program(cfg, spec, epoch_len, False, with_delay)
-    heal = _epoch_program(cfg, spec, heal_len, True, with_delay)
+    chaos = _epoch_program(cfg, spec, epoch_len, False, with_delay,
+                           with_crash)
+    heal = _epoch_program(cfg, spec, heal_len, True, with_delay, with_crash)
     dp = jnp.float32(drop_p)
     lp = jnp.float32(delay_p)
     pp = jnp.float32(partition_p)
+    cp = jnp.float32(crash_p)
+    dr = jnp.int32(crash_cfg.down_rounds if with_crash else 1)
+    kl = jnp.bool_(crash_cfg.durability == "stable" if with_crash else True)
     z = jnp.float32(0.0)
 
     def _sync(x):
@@ -394,13 +634,15 @@ def run_chaos(
     commits = []
     done = 0
     while done < rounds:
-        state, inbox, held, key, viol, dc = chaos(
-            state, inbox, held, key, prop_len, prop_data, viol, dp, lp, pp
+        state, inbox, held, crash_state, key, viol, dc = chaos(
+            state, inbox, held, crash_state, key, prop_len, prop_data, viol,
+            dp, lp, pp, cp, dr, kl
         )
         _sync(viol.multi_leader)
         done += epoch_len
-        state, inbox, held, key, viol, dh = heal(
-            state, inbox, held, key, prop_len, prop_data, viol, z, z, z
+        state, inbox, held, crash_state, key, viol, dh = heal(
+            state, inbox, held, crash_state, key, prop_len, prop_data, viol,
+            z, z, z, z, dr, kl
         )
         _sync(viol.multi_leader)
         done += heal_len
@@ -416,20 +658,71 @@ def run_chaos(
     for _ in range(6):
         if leaders() == C:
             break
-        state, inbox, held, key, viol, dh = heal(
-            state, inbox, held, key, prop_len, prop_data, viol, z, z, z
+        state, inbox, held, crash_state, key, viol, dh = heal(
+            state, inbox, held, crash_state, key, prop_len, prop_data, viol,
+            z, z, z, z, dr, kl
         )
         done += heal_len
         commits.append((0, int(dh)))
     has_leader = leaders()
     v = jax.device_get(viol)
-    return {
+    rep = {
         "groups": C,
         "rounds": done,
         "multi_leader": int(v.multi_leader),
         "hash_mismatch": int(v.hash_mismatch),
         "commit_regress": int(v.commit_regress),
+        "lost_commit": int(v.lost_commit),
+        "log_divergence": int(v.log_divergence),
+        "term_regress": int(v.term_regress),
         "groups_with_leader_after_heal": has_leader,
         "heal_commits_last_epoch": commits[-1][1],
         "epoch_commits": commits,
+    }
+    if with_crash:
+        rep["crash_p"] = crash_p
+        rep["crash_down_rounds"] = crash_cfg.down_rounds
+        rep["crash_durability"] = crash_cfg.durability
+        rep.update(crash_metrics_report(crash_state.metrics))
+    return rep
+
+
+VIOLATION_KEYS = (
+    "multi_leader", "hash_mismatch", "commit_regress",
+    "lost_commit", "log_divergence", "term_regress",
+)
+
+
+def summarize_chaos(rep: dict, *, rounds: int, epoch_len: int,
+                    heal_len: int, liveness_frac: float = 0.2) -> dict:
+    """Pure post-processing of a run_chaos report: the safety verdict,
+    the tester-style recovery bar, and the fault-epoch liveness floor.
+    Lives here (not in chaos_run.py) so it is unit-testable and every
+    driver computes the gates the same way.
+
+    The liveness floor guards fault epochs themselves (VERDICT r3 Weak
+    #4: heal-time recovery alone would let a wedge-everything regression
+    pass): a fraction of the fault-free throughput (1 commit/group/
+    round), defaulted for the standard mix; harsher mixes must set the
+    fraction consciously (heavy partitions legally starve minority
+    sides). WaitHealth extensions append (0, dh) rows to epoch_commits
+    that are NOT fault epochs and must not inflate the floor, hence the
+    reconstruction from the requested round budget.
+    """
+    safe = all(rep.get(k, 0) == 0 for k in VIOLATION_KEYS)
+    recovered = (
+        rep["groups_with_leader_after_heal"] == rep["groups"]
+        and rep["heal_commits_last_epoch"] > 0
+    )
+    faulted = sum(dc for dc, _ in rep["epoch_commits"])
+    # fault epochs = the while-loop iterations of run_chaos (epoch_len +
+    # heal_len rounds per iteration)
+    faulted_rounds = -(-rounds // (epoch_len + heal_len)) * epoch_len
+    floor = int(liveness_frac * rep["groups"] * faulted_rounds)
+    return {
+        "safe": safe,
+        "recovered": recovered,
+        "faulted_commits": faulted,
+        "faulted_liveness_floor": floor,
+        "lively": faulted >= floor,
     }

@@ -26,7 +26,14 @@ import jax.numpy as jnp
 from etcd_tpu.models.raft import node_round
 from etcd_tpu.models.state import NodeState, init_node
 from etcd_tpu.ops.outbox import Outbox
-from etcd_tpu.types import ENT_FIELDS, Msg, Spec
+from etcd_tpu.types import (
+    ENT_FIELDS,
+    Msg,
+    NONE_ID,
+    PR_PROBE,
+    ROLE_FOLLOWER,
+    Spec,
+)
 from etcd_tpu.utils.config import RaftConfig
 
 
@@ -172,6 +179,133 @@ def _init_fleet_core(spec: Spec, C: int, election_tick: int,
             jnp.arange(C, dtype=jnp.int32)
         )
     )(jnp.arange(spec.M, dtype=jnp.int32))
+
+
+def _node_mask(mask: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a per-node [M, C] mask to a fleet leaf's [M, ..., C]
+    rank by inserting singleton middle axes."""
+    extra = leaf.ndim - 2
+    return mask.reshape(mask.shape[0], *([1] * extra), mask.shape[-1])
+
+
+def crash_restart_fleet(
+    spec: Spec,
+    state: NodeState,
+    crashed: jnp.ndarray,
+    stable: jnp.ndarray,
+    rand_to: jnp.ndarray,
+    keep_log: bool | jnp.ndarray = True,
+) -> tuple[NodeState, jnp.ndarray]:
+    """Crash and immediately restart the masked nodes, keeping only their
+    modeled durable state (the classification table in models/state.py:
+    DURABLE / CAPPED / REPLAY / VOLATILE).
+
+    ``crashed``/``stable``/``rand_to`` are [M, C]: which nodes crash, each
+    node's fsync'd log prefix (entries past it are lost — the fsync-lag
+    window), and the restarted node's fresh randomized election timeout.
+    ``keep_log=False`` (python bool or traced scalar — the chaos tier
+    passes it as a runtime operand so one traced program serves both
+    durability models) is the deliberately-broken "persist nothing past
+    the snapshot" model (utils/config.py CrashConfig.durability="none")
+    used to prove the leader-completeness checker fires.
+
+    Ring slots past the durable last_index are NOT scrubbed: the valid
+    window (snap_index, last_index] gates every log read, so the lost
+    suffix is unreachable, and future appends overwrite it — same reason
+    the reference truncates by cursor, not by zeroing pages.
+
+    Returns (state, entries_lost) where entries_lost counts log entries
+    dropped by the fsync-lag (or persist-nothing) wipe this call.
+    """
+    floor = state.snap_index                       # snapshots fsync eagerly
+    durable_last = jnp.where(
+        keep_log, jnp.maximum(jnp.minimum(state.last_index, stable), floor),
+        floor,
+    )
+    # commit-only advances never force an fsync (MustSync,
+    # raft/node.go:586-593): the persisted commit is capped by the
+    # durable log and may legally regress across the crash
+    durable_commit = jnp.maximum(jnp.minimum(state.commit, durable_last), floor)
+    entries_lost = jnp.where(
+        crashed, state.last_index - durable_last, 0
+    ).sum().astype(jnp.int32)
+
+    def sel(field: str, restarted: jnp.ndarray) -> jnp.ndarray:
+        cur = getattr(state, field)
+        return jnp.where(_node_mask(crashed, cur), restarted.astype(cur.dtype), cur)
+
+    zM = jnp.zeros_like(state.match)               # [M, M, C] i32
+    fMM = jnp.zeros_like(state.votes_responded)    # [M, M, C] bool
+    z2 = jnp.zeros_like(state.commit)              # [M, C] i32
+    state = state.replace(
+        # CAPPED
+        last_index=sel("last_index", durable_last),
+        commit=sel("commit", durable_commit),
+        # REPLAY: rewind the state machine + applied config to the
+        # snapshot; the fused apply loop re-derives the identical hash
+        applied=sel("applied", state.snap_index),
+        applied_hash=sel("applied_hash", state.snap_hash),
+        voters=sel("voters", state.snap_voters),
+        voters_out=sel("voters_out", state.snap_voters_out),
+        learners=sel("learners", state.snap_learners),
+        learners_next=sel("learners_next", state.snap_learners_next),
+        auto_leave=sel("auto_leave", state.snap_auto_leave),
+        # VOLATILE: fresh-follower boot values
+        lead=sel("lead", jnp.full_like(state.lead, NONE_ID)),
+        role=sel("role", jnp.full_like(state.role, ROLE_FOLLOWER)),
+        election_elapsed=sel("election_elapsed", z2),
+        heartbeat_elapsed=sel("heartbeat_elapsed", z2),
+        randomized_timeout=sel("randomized_timeout", rand_to),
+        match=sel("match", zM),
+        next_idx=sel("next_idx", durable_last[:, None, :] + 1),
+        pr_state=sel("pr_state", jnp.full_like(state.pr_state, PR_PROBE)),
+        probe_sent=sel("probe_sent", fMM),
+        pending_snapshot=sel("pending_snapshot", zM),
+        recent_active=sel("recent_active", fMM),
+        infl_ends=sel("infl_ends", jnp.zeros_like(state.infl_ends)),
+        infl_start=sel("infl_start", zM),
+        infl_count=sel("infl_count", zM),
+        votes_responded=sel("votes_responded", fMM),
+        votes_granted=sel("votes_granted", fMM),
+        pending_conf_index=sel("pending_conf_index", z2),
+        uncommitted_size=sel("uncommitted_size", z2),
+        lead_transferee=sel("lead_transferee",
+                            jnp.full_like(state.lead_transferee, NONE_ID)),
+        ro_ctx=sel("ro_ctx", jnp.zeros_like(state.ro_ctx)),
+        ro_index=sel("ro_index", jnp.zeros_like(state.ro_index)),
+        ro_from=sel("ro_from", jnp.full_like(state.ro_from, NONE_ID)),
+        ro_acks=sel("ro_acks", jnp.zeros_like(state.ro_acks)),
+        ro_count=sel("ro_count", z2),
+        ro_pend_ctx=sel("ro_pend_ctx", jnp.zeros_like(state.ro_pend_ctx)),
+        ro_pend_from=sel("ro_pend_from",
+                         jnp.full_like(state.ro_pend_from, NONE_ID)),
+        ro_pend_count=sel("ro_pend_count", z2),
+        rs_ctx=sel("rs_ctx", jnp.zeros_like(state.rs_ctx)),
+        rs_index=sel("rs_index", jnp.zeros_like(state.rs_index)),
+        rs_count=sel("rs_count", z2),
+        # DURABLE fields (term, vote, log ring, snap_*, nid, rng_key)
+        # pass through untouched
+    )
+    return state, entries_lost
+
+
+def wipe_crashed_traffic(spec: Spec, inbox: Msg, crashed: jnp.ndarray) -> Msg:
+    """Drop every in-flight message FROM or TO a crashed node: its
+    unsent/undelivered traffic dies with the process. The FROM wipe is
+    load-bearing for the durability model — the engine emits a round's
+    messages before the modeled fsync completes, so killing the crashed
+    sender's in-flight row is what makes "entries past `stable` are lost"
+    safe (no acknowledgement of an unsynced entry is ever delivered,
+    the lockstep analog of the Ready contract's persist-before-send).
+    The TO wipe is plain message loss, always legal by the transport
+    contract (etcdserver/raft.go:107-110). Only the type leaf is zeroed —
+    type 0 means "empty slot" and the other fields are never read."""
+    M, K = spec.M, spec.K
+    C = inbox.type.shape[-1]
+    t5 = inbox.type.reshape(M, K, M, C)            # [from, K, to, C] view
+    kill = crashed[:, None, None, :] | crashed[None, None, :, :]
+    t5 = jnp.where(kill, 0, t5)
+    return inbox.replace(type=t5.reshape(M, K * M, C).astype(inbox.type.dtype))
 
 
 def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):

@@ -67,6 +67,35 @@ def zero_metrics() -> FleetMetrics:
     )
 
 
+class CrashMetrics(struct.PyTreeNode):
+    """Device-resident crash/restart event counters for the chaos tier's
+    crash–restart fault class (harness/chaos.py). Kept separate from
+    :class:`FleetMetrics` because they ride the chaos epoch's scan carry,
+    not the metered round: the chaos program accumulates them as the same
+    kind of fused i32 reductions as its Violations counters and the host
+    reads them once per report."""
+
+    crashes_injected: jnp.ndarray     # nodes killed by the crash mask
+    entries_lost_fsync: jnp.ndarray   # log entries dropped past `stable`
+    restarts_completed: jnp.ndarray   # down-timers that reached 0
+
+
+def zero_crash_metrics() -> CrashMetrics:
+    z = jnp.int32(0)
+    return CrashMetrics(crashes_injected=z, entries_lost_fsync=z,
+                        restarts_completed=z)
+
+
+def crash_metrics_report(m: CrashMetrics) -> dict:
+    """One host transfer -> plain-dict counters for the chaos report JSON."""
+    m = jax.device_get(m)
+    return {
+        "crashes_injected": int(m.crashes_injected),
+        "entries_lost_fsync": int(m.entries_lost_fsync),
+        "restarts_completed": int(m.restarts_completed),
+    }
+
+
 def build_metered_round(cfg: RaftConfig, spec: Spec):
     """Round program with fused metric updates.
 

@@ -120,6 +120,65 @@ class NodeState(struct.PyTreeNode):
     rs_count: jnp.ndarray     # i32
 
 
+# ---------------------------------------------------------------------------
+# Crash-durability classification (harness/chaos.py crash faults).
+#
+# Every NodeState field belongs to exactly one class; the chaos tier's
+# crash–restart wipe (models/engine.py crash_restart_fleet) implements this
+# table, and tests/test_recovery_crash.py proves the two agree — a new field
+# added here without a classification fails the suite instead of silently
+# surviving (or losing) a simulated crash.
+#
+#  * DURABLE: survives a crash as-is. HardState term/vote (MustSync forces
+#    an fsync before any message reflecting them is sent,
+#    raft/node.go:586-593), the snapshot metadata (snapshots fsync
+#    synchronously before use), the node id, and the log ring ARRAYS
+#    (slots past the durable last_index are dead by the last_index gate —
+#    the window (snap_index, last_index] defines validity, so lost-suffix
+#    slots need no scrub).
+#  * CAPPED: survives up to the durable floor. last_index drops to the
+#    fsync'd prefix (max(min(last_index, stable), snap_index)); commit is
+#    additionally capped by it (commit-only advances don't fsync, so a
+#    restart may legally REGRESS commit — the chaos commit-monotonicity
+#    checker exempts crash rounds).
+#  * REPLAY: re-derived by replaying the durable log from the snapshot:
+#    applied/applied_hash rewind to the snapshot cursor (the fused apply
+#    loop then re-applies committed entries, reproducing the identical
+#    hash chain — which the KV_HASH checker verifies), and the applied
+#    config masks rewind to the snapshot's ConfState.
+#  * VOLATILE: reset to fresh-follower boot values (raft.go:318-370
+#    newRaft on restart): role/lead/timers/tracker/votes/queues. The
+#    randomized election timeout is re-drawn; rng_key is carried through
+#    (PRNG state has no semantic content — any value is a valid restart).
+# ---------------------------------------------------------------------------
+
+DURABLE_FIELDS = (
+    "nid", "term", "vote",
+    "log_term", "log_data", "log_type",
+    "snap_index", "snap_term", "snap_hash",
+    "snap_voters", "snap_voters_out", "snap_learners", "snap_learners_next",
+    "snap_auto_leave",
+    "rng_key",
+)
+CAPPED_FIELDS = ("last_index", "commit")
+REPLAY_FIELDS = (
+    "applied", "applied_hash",
+    "voters", "voters_out", "learners", "learners_next", "auto_leave",
+)
+VOLATILE_FIELDS = (
+    "lead", "role",
+    "election_elapsed", "heartbeat_elapsed", "randomized_timeout",
+    "match", "next_idx", "pr_state", "probe_sent", "pending_snapshot",
+    "recent_active",
+    "infl_ends", "infl_start", "infl_count",
+    "votes_responded", "votes_granted",
+    "pending_conf_index", "uncommitted_size", "lead_transferee",
+    "ro_ctx", "ro_index", "ro_from", "ro_acks", "ro_count",
+    "ro_pend_ctx", "ro_pend_from", "ro_pend_count",
+    "rs_ctx", "rs_index", "rs_count",
+)
+
+
 def init_node(
     spec: Spec,
     nid: int | jnp.ndarray,

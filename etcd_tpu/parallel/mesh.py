@@ -164,7 +164,8 @@ def build_global_invariants(cfg: RaftConfig, spec: Spec, mesh: Mesh):
     cluster shard) and ONE scalar psum per counter crosses the mesh.
     This is the cross-shard composition build_shard_map_round exists
     for: per-shard math + a collective only at the invariant boundary,
-    so the ICI cost is 3 scalars per check instead of the fleet."""
+    so the ICI cost is one scalar per Violations counter (6 since the
+    crash tier) per check instead of the fleet."""
     from etcd_tpu.harness.chaos import check_invariants, zero_violations
 
     axes = _mesh_axes(mesh)

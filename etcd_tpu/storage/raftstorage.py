@@ -248,6 +248,55 @@ class MemoryStorage(Storage):
         self.ents = self.ents[:pos] + list(ents)
 
 
+def bootstrap_from_wal(wal) -> tuple["MemoryStorage", bytes]:
+    """Crash–restart recovery: replay a WAL into a fresh MemoryStorage —
+    the host-storage mirror of the chaos tier's on-device crash model
+    (etcdserver/storage.go readWAL + raft restart path). ``wal`` is any
+    object with the :meth:`etcd_tpu.storage.wal.WAL.read_all` contract;
+    read_all itself repairs a torn tail, so what arrives here is exactly
+    the durable prefix.
+
+    Validates the recovery invariant the device checkers enforce per
+    round: the persisted HardState's commit must be covered by the
+    surviving log (WAL.save writes a batch's entries BEFORE its
+    hardstate record, so a prefix tear can drop a batch's hardstate but
+    never keep a hardstate whose entries it dropped). A violation means
+    the WAL bytes are inconsistent in a way repair cannot have
+    produced — fail loudly rather than boot a node that breaks leader
+    completeness. (Snapshot-vs-tail consistency needs no check:
+    apply_snapshot resets the storage window to the snapshot cursor, so
+    the replayed tail can never sit behind it.)
+
+    Returns (storage, metadata).
+    """
+    from etcd_tpu.storage.wal import WALError
+
+    metadata, hs, ents, snap = wal.read_all()
+    ms = MemoryStorage()
+    # index 0 is the initial empty-snapshot marker some WALs open with;
+    # a fresh MemoryStorage already sits at index 0 and apply_snapshot
+    # would reject it as out of date
+    if snap and snap["index"] > 0:
+        ms.apply_snapshot(Snapshot(
+            meta=SnapshotMeta(index=snap["index"], term=snap["term"]),
+        ))
+    if hs is not None:
+        ms.set_hard_state(HardState(
+            term=hs["term"], vote=hs["vote"], commit=hs["commit"],
+        ))
+    ms.append([
+        Entry(index=e["index"], term=e["term"],
+              type=e.get("type", ENTRY_NORMAL), data=e.get("data", 0))
+        for e in ents
+    ])
+    if ms.hard_state.commit > ms.last_index():
+        raise WALError(
+            f"persisted commit {ms.hard_state.commit} exceeds the durable "
+            f"log tail {ms.last_index()} — WAL bytes are inconsistent"
+        )
+    return ms, metadata
+
+
 class PayloadTable:
     """Intern table mapping arbitrary payloads <-> int32 data words.
 

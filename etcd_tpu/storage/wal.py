@@ -14,7 +14,13 @@ from __future__ import annotations
 import os
 import pickle
 
-from etcd_tpu.storage.walcodec import get_codec
+from etcd_tpu.storage.walcodec import (
+    HEADER_SIZE,
+    first_frame_bytes_needed,
+    frame_is_incomplete,
+    get_codec,
+    tail_chains_cleanly,
+)
 
 REC_METADATA = 1
 REC_ENTRIES = 2
@@ -111,17 +117,52 @@ class WAL:
             self._f = None
 
     # -- replay --------------------------------------------------------------
+    def _probe_first_frame(self, seg: str) -> str:
+        """Classify a segment by its FIRST frame (each segment carries an
+        independent crc chain from 0, so frame one is self-checking; if
+        it is broken, nothing after it can be verified either) without
+        reading the whole file:
+
+          * ``"valid"``: decodes cleanly — the segment holds records;
+          * ``"corrupt"``: the frame is COMPLETE but its crc fails —
+            bit rot on durable bytes, never a crash artifact;
+          * ``"debris"``: no complete frame — a torn first append.
+        """
+        path = os.path.join(self.dir, seg)
+        with open(path, "rb") as f:
+            head = f.read(HEADER_SIZE)
+            need = first_frame_bytes_needed(head)
+            if need is None or need > os.path.getsize(path):
+                return "debris"
+            buf = head + f.read(need - len(head))
+        if self.codec.decode(buf, 0, 0) is not None:
+            return "valid"
+        return "corrupt"
+
     def read_all(self, from_index: int = 0):
         """(metadata, hardstate, entries, snapshot) replay; truncates a torn
-        tail like wal.openAtTail+repair. entries are those with
-        index > max(from_index, last snapshot marker)."""
+        or corrupted final record like wal.openAtTail+repair (repair.go)
+        instead of raising. entries are those with
+        index > max(from_index, last snapshot marker).
+
+        A torn frame is tolerated at the tail of the LOG, not just the
+        last file: a crash inside ``cut`` (or an fsync-lagged filesystem
+        dropping a synced-late tail) can leave the torn record in the
+        penultimate segment with nothing but unsynced debris after it.
+        The repair truncates the torn tail and REMOVES the later
+        record-free debris segments. Corruption followed by any segment
+        with decodable records is genuinely mid-log and still fails
+        loudly — patching it would make a silent hole."""
         metadata = b""
         hardstate: dict | None = None
         snapshot: dict | None = None
         by_index: dict[int, dict] = {}
         crc = 0
+        torn = False
         segs = self._segments()
         for si, seg in enumerate(segs):
+            if torn:
+                break
             path = os.path.join(self.dir, seg)
             with open(path, "rb") as f:
                 buf = f.read()
@@ -130,20 +171,57 @@ class WAL:
             while off < len(buf):
                 hit = self.codec.decode(buf, off, crc)
                 if hit is None:
-                    if si != len(segs) - 1:
-                        # a torn frame is only legal at the very tail of the
-                        # log (repair.go repairs ErrUnexpectedEOF in the last
-                        # file only); mid-log corruption must not be patched
-                        # into a silent hole
+                    debris = segs[si + 1:]
+                    probes = {s: self._probe_first_frame(s) for s in debris}
+                    if "valid" in probes.values():
+                        # records exist PAST the tear: this is mid-log
+                        # corruption, not a torn tail; it must not be
+                        # patched into a silent hole
                         raise WALError(f"corrupt record mid-log in {seg}")
-                    # torn tail: truncate and stop replay (repair.go)
+                    if not frame_is_incomplete(buf, off):
+                        # COMPLETE frame, bad crc. In a non-final
+                        # segment the bytes were durable (cut() fsyncs
+                        # a segment before opening the next), so this
+                        # is bit rot, and repairing it would silently
+                        # drop fsynced records. In the final segment
+                        # the torn-append window CAN leave a junk tail
+                        # that happens to parse as a complete frame —
+                        # it is rot (refuse) only when what follows the
+                        # frame is a self-consistent crc-chained record
+                        # run to EOF, i.e. real records stand behind it.
+                        end = off + first_frame_bytes_needed(
+                            buf[off:off + HEADER_SIZE])
+                        if debris or tail_chains_cleanly(buf, end):
+                            raise WALError(
+                                f"corrupt durable record in {seg} "
+                                "(complete frame, crc mismatch)")
+                    rotted = [s for s, p in probes.items() if p == "corrupt"]
+                    if rotted:
+                        # same rule for the segments we would unlink: a
+                        # complete-but-crc-broken first frame is bit rot
+                        # on durable bytes, not torn-append debris —
+                        # removing it would silently delete records
+                        raise WALError(
+                            f"corrupt durable record in {rotted[0]} "
+                            "(complete frame, crc mismatch)")
+                    # torn tail: truncate in place, drop record-free
+                    # debris segments, stop replay (repair.go)
                     from etcd_tpu.utils.logging import get_logger
 
                     get_logger().warning(
                         "repaired torn wal tail in %s at offset %d", seg, off
                     )
+                    if self._f and not self._f.closed:
+                        # the open append handle may point at a debris
+                        # segment about to be unlinked
+                        self._f.close()
+                        self._f = None
                     with open(path, "ab") as f:
                         f.truncate(off)
+                    for s in debris:
+                        get_logger().warning("dropping torn wal debris %s", s)
+                        os.remove(os.path.join(self.dir, s))
+                    torn = True
                     break
                 consumed, rtype, payload, crc = hit
                 off += consumed

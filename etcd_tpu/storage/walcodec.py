@@ -21,6 +21,62 @@ def _pad8(n: int) -> int:
     return (n + 7) & ~7
 
 
+HEADER_SIZE = _HEADER.size
+
+
+def first_frame_bytes_needed(header: bytes) -> int | None:
+    """Total on-disk size of a frame whose first ``HEADER_SIZE`` bytes are
+    ``header`` (None if the header itself is short) — lets a caller probe
+    a segment's first frame without reading the whole file."""
+    if len(header) < _HEADER.size:
+        return None
+    return _HEADER.size + _pad8(_HEADER.unpack_from(header, 0)[0])
+
+
+def tail_chains_cleanly(buf, off: int) -> bool:
+    """Whether the bytes at ``off`` parse as one or more COMPLETE frames
+    whose chained crcs are self-consistent (the first frame's stored crc
+    taken as the chain seed — the chain up to here is broken, so it
+    cannot be verified absolutely) and end exactly at EOF. That is the
+    signature of real fsync'd records surviving PAST a corrupt frame
+    (bit rot), as opposed to the unstructured junk a torn append leaves;
+    WAL.read_all uses it to keep mid-segment rot loud while still
+    repairing a genuinely torn tail."""
+    n = len(buf)
+    if off >= n:
+        return False
+    crc = None
+    while off < n:
+        if n - off < _HEADER.size:
+            return False
+        plen, _, want = _HEADER.unpack_from(buf, off)
+        total = _HEADER.size + _pad8(plen)
+        if n - off < total:
+            return False
+        if crc is not None:
+            payload = bytes(buf[off + _HEADER.size:off + _HEADER.size + plen])
+            if zlib.crc32(payload, crc) != want:
+                return False
+        crc = want
+        off += total
+    return True
+
+
+def frame_is_incomplete(buf, off: int) -> bool:
+    """Whether the bytes at ``off`` cannot hold a complete frame — the
+    buffer ends mid-record, the signature of a torn append (segments are
+    plain appends, never preallocated, so a crash tears at EOF). A
+    COMPLETE frame that fails its CRC is the other way decode returns
+    None, and means bit rot on durable bytes, not a tear — the caller
+    (WAL.read_all) uses the distinction to keep mid-log corruption loud.
+    """
+    remaining = len(buf) - off
+    if remaining < _HEADER.size:
+        return True
+    plen = _HEADER.unpack_from(buf, off)[0]
+    return remaining < _HEADER.size + _pad8(plen)
+
+
 class _PyCodec:
     """Fallback codec (identical framing)."""
 

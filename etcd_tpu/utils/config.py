@@ -184,3 +184,46 @@ class RaftConfig:
     @property
     def max_uncommitted_entries(self) -> int:
         return self.max_uncommitted if self.max_uncommitted > 0 else (1 << 30)
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashConfig:
+    """Crash–restart fault model for the chaos tier (harness/chaos.py).
+
+    Like the per-round crash probability, these knobs ride as RUNTIME
+    operands of the epoch program (run_chaos passes down_rounds as an
+    i32 and durability as a keep_log bool), alongside the
+    drop/delay/partition probabilities — one traced program serves every
+    crash mix; only crash_p > 0 vs == 0 changes program structure.
+
+    The durability model mirrors the reference's fsync discipline
+    (raft/node.go:586-593 MustSync + the Ready contract "persist before
+    send"): HardState term/vote survive a crash outright, the log survives
+    up to a per-node ``stable`` index that lags ``last_index`` by one
+    lockstep round (the modeled fsync latency), commit is capped at the
+    durable log (commit-only advances never force an fsync), and
+    snapshots/compaction are synchronously durable. Entries past
+    ``stable`` are LOST — which is safe exactly because the engine wipes
+    the crashed node's in-flight outgoing messages with it, so no
+    acknowledgement of an unsynced entry is ever observed (the lockstep
+    analog of "the ack is only sent after fsync").
+    """
+
+    # rounds a crashed node stays down before restarting with a fresh
+    # randomized election timeout (the tester's SIGKILL->restart window)
+    down_rounds: int = 3
+    # "stable": the fsync-lag model above (the honest one).
+    # "none": a deliberately-broken model that persists nothing past the
+    # last snapshot — it exists so tests can prove the leader-completeness
+    # checker actually fires when committed entries disappear.
+    durability: str = "stable"
+
+    def __post_init__(self):
+        if self.down_rounds < 1:
+            # a 0-round crash would restart within the crash round itself,
+            # before its wiped in-flight messages are even dropped
+            raise ValueError("down_rounds must be >= 1")
+        if self.durability not in ("stable", "none"):
+            raise ValueError(
+                f"unknown durability {self.durability!r}; "
+                "known: ['none', 'stable']")

@@ -6,10 +6,13 @@
 # reference datadriven goldens, the ring/quorum kernels, the trace-specialization
 # equivalence proofs (every perf rung), replication + election
 # scenarios. NOT a substitute for the full
-# suite before a commit milestone — wire façades, chaos, tools and e2e
-# only run there.
+# suite before a commit milestone — wire façades, the network/lease
+# chaos tiers, tools and e2e only run there. The crash-chaos tier's
+# fast configuration (tests/test_recovery_crash.py: <=64 groups, <=2 fault
+# epochs; the 262k variant stays behind -m slow) runs HERE because
+# crash recovery exercises the raft state machines this tier guards.
 cd "$(dirname "$0")"
-exec python -m pytest -q \
+exec python -m pytest -q -m 'not slow' \
   tests/test_datadriven_quorum.py \
   tests/test_datadriven_confchange.py \
   tests/test_paper.py \
@@ -23,4 +26,5 @@ exec python -m pytest -q \
   tests/test_deferred_emit.py \
   tests/test_apply_specialization.py \
   tests/test_sparse_held.py \
+  tests/test_recovery_crash.py \
   "$@"

@@ -2,7 +2,9 @@
 # Full-suite run with wall-clock + RSS telemetry (single-core VM: run alone).
 cd /root/repo
 T0=$(date +%s)
-python -m pytest tests/ -q > suite_run.log 2>&1 &
+# -m 'not slow': the full-scale tiers (e.g. the 262k-group crash-chaos
+# run) are explicit TPU invocations, not suite members on this VM
+python -m pytest tests/ -q -m 'not slow' > suite_run.log 2>&1 &
 PYT=$!
 ( while kill -0 $PYT 2>/dev/null; do
     ps -o rss= -p $PYT
@@ -11,4 +13,17 @@ PYT=$!
 wait $PYT
 RC=$?
 echo "WALL_SECONDS=$(( $(date +%s) - T0 )) RC=$RC" >> suite_run.log
+
+# Optional crash-chaos smoke (SUITE_CHAOS=1): a small chaos_run.py pass
+# with crash faults on, exercising the driver + summarize gates end to
+# end. Scale evidence runs use chaos_run.py directly on TPU
+# (CHAOS_C=262144 CHAOS_CRASH=0.01).
+if [ "${SUITE_CHAOS:-0}" != "0" ]; then
+  CHAOS_C=${CHAOS_C:-256} CHAOS_ROUNDS=${CHAOS_ROUNDS:-75} \
+  CHAOS_CRASH=${CHAOS_CRASH:-0.02} CHAOS_LEASE=${CHAOS_LEASE:-0} \
+    python chaos_run.py > chaos_crash_smoke.json 2> chaos_crash_smoke.err
+  CRC=$?
+  echo "CHAOS_SMOKE_RC=$CRC" >> suite_run.log
+  [ $RC -eq 0 ] && RC=$CRC
+fi
 exit $RC

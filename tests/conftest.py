@@ -65,6 +65,10 @@ def pytest_configure(config):
         "markers",
         "smoke: fast core-correctness tier (-m smoke for quick "
         "iteration on models/raft.py edits)")
+    config.addinivalue_line(
+        "markers",
+        "slow: full-scale tiers excluded from the tier-1 run "
+        "(-m 'not slow'); e.g. the 262k-group crash-chaos run")
 
 
 def bootstrap_cert_cn_auth(call):

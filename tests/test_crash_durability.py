@@ -1,0 +1,294 @@
+"""Host-storage mirror of the crash–restart fault class (ISSUE 3):
+crash-restart round-trips through the segmented WAL + MemoryStorage,
+including torn-final-record truncation in WAL.read_all (wal/repair.go
+behavior) and the crash-during-cut debris case.
+
+The device-tier analogs (volatile-state wipe, fsync-lag loss, recovery
+checkers) live in tests/test_recovery_crash.py; this file proves the same
+durability contract on the byte-level storage path.
+"""
+import os
+import random
+
+import pytest
+
+from etcd_tpu.storage.raftstorage import bootstrap_from_wal
+from etcd_tpu.storage.wal import WAL, WALError
+
+
+def _fill(w: WAL, n: int, term: int = 1, commit_lag: int = 1):
+    """n save() batches: entry i + hardstate committing i - commit_lag."""
+    for i in range(1, n + 1):
+        w.save({"term": term, "vote": 0, "commit": max(i - commit_lag, 0)},
+               [{"index": i, "term": term, "data": i * 11, "type": 0}])
+
+
+def test_wal_torn_final_record_corrupt_in_place(tmp_path):
+    """Corrupting the tail BYTES of the last segment (not appending
+    garbage): read_all truncates the now-unverifiable final record and
+    replays the durable prefix instead of raising."""
+    d = str(tmp_path / "wal")
+    w = WAL(d)
+    _fill(w, 3)
+    w.close()
+    seg = os.path.join(d, sorted(os.listdir(d))[-1])
+    size = os.path.getsize(seg)
+    data = bytearray(open(seg, "rb").read())
+    # smash the last record's payload bytes (the final record is the
+    # hardstate of batch 3; its frame is > 16 bytes, so offset -12 is
+    # inside the payload, not the pad)
+    for off in range(size - 12, size - 8):
+        data[off] ^= 0xFF
+    open(seg, "wb").write(bytes(data))
+
+    w2 = WAL(d)
+    _, hs, ents, _ = w2.read_all()
+    # entries 1..3 survive (written before the smashed hardstate);
+    # hardstate falls back to batch 2's
+    assert [e["index"] for e in ents] == [1, 2, 3]
+    assert hs == {"term": 1, "vote": 0, "commit": 1}
+    assert os.path.getsize(seg) < size  # torn tail truncated in place
+    # the repaired WAL appends cleanly
+    w2.save(None, [{"index": 4, "term": 1, "data": 44, "type": 0}])
+    w2.close()
+    _, _, ents, _ = WAL(d).read_all()
+    assert [e["index"] for e in ents] == [1, 2, 3, 4]
+
+
+def test_wal_truncated_final_record(tmp_path):
+    """fsync lag: the file loses its tail mid-record. Replay returns the
+    durable prefix."""
+    d = str(tmp_path / "wal")
+    w = WAL(d)
+    _fill(w, 5)
+    w.close()
+    seg = os.path.join(d, sorted(os.listdir(d))[-1])
+    with open(seg, "ab") as f:
+        f.truncate(os.path.getsize(seg) - 7)
+    _, hs, ents, _ = WAL(d).read_all()
+    # the tear lands mid-hardstate-of-batch-5: entries survive through 5,
+    # the newest surviving hardstate is batch 4's
+    assert [e["index"] for e in ents] == [1, 2, 3, 4, 5]
+    assert hs["commit"] == 3
+
+
+def test_wal_crash_during_cut_drops_debris(tmp_path):
+    """A tear at the tail of the penultimate segment with nothing but
+    record-free debris after it (the crash-inside-cut window): repair
+    truncates the tear and unlinks the debris segment instead of
+    raising."""
+    import etcd_tpu.storage.wal as walmod
+
+    d = str(tmp_path / "wal")
+    old = walmod.SEGMENT_BYTES
+    walmod.SEGMENT_BYTES = 256
+    try:
+        w = WAL(d)
+        for i in range(1, 20):
+            w.save(None, [{"index": i, "term": 1, "data": i, "type": 0}])
+        w.close()
+        segs = sorted(f for f in os.listdir(d) if f.endswith(".wal"))
+        assert len(segs) > 2
+        # tear the tail of the penultimate segment and reduce the last
+        # one to a record-free stub (its first frame torn too)
+        pen = os.path.join(d, segs[-2])
+        with open(pen, "ab") as f:
+            f.truncate(os.path.getsize(pen) - 5)
+        last = os.path.join(d, segs[-1])
+        with open(last, "r+b") as f:
+            f.truncate(3)
+
+        w2 = WAL(d)
+        _, _, ents, _ = w2.read_all()
+        assert ents, "durable prefix must replay"
+        assert ents[-1]["index"] < 19
+        assert not os.path.exists(last), "debris segment must be unlinked"
+        # appends continue on the repaired tail
+        nxt = ents[-1]["index"] + 1
+        w2.save(None, [{"index": nxt, "term": 1, "data": 0, "type": 0}])
+        w2.close()
+        _, _, ents2, _ = WAL(d).read_all()
+        assert ents2[-1]["index"] == nxt
+    finally:
+        walmod.SEGMENT_BYTES = old
+
+
+def test_wal_bitrot_in_durable_segment_refuses(tmp_path):
+    """A COMPLETE frame failing its crc in a non-final segment is bit rot
+    on fsync'd bytes (cut() synced the whole segment before opening the
+    next), not a torn append — repair must refuse even when everything
+    after it is record-free debris, or it would silently drop durable
+    records. Only an INCOMPLETE trailing frame is a tear there."""
+    import etcd_tpu.storage.wal as walmod
+    from etcd_tpu.storage.walcodec import get_codec
+
+    d = str(tmp_path / "wal")
+    old = walmod.SEGMENT_BYTES
+    walmod.SEGMENT_BYTES = 256
+    try:
+        w = WAL(d)
+        for i in range(1, 20):
+            w.save(None, [{"index": i, "term": 1, "data": i, "type": 0}])
+        w.close()
+        segs = sorted(f for f in os.listdir(d) if f.endswith(".wal"))
+        assert len(segs) > 2
+        pen = os.path.join(d, segs[-2])
+        buf = open(pen, "rb").read()
+        # flip a payload byte of the segment's SECOND frame: a complete
+        # mid-segment record, well clear of the trailing-append window
+        first_len = get_codec().decode(buf, 0, 0)[0]
+        data = bytearray(buf)
+        data[first_len + 12] ^= 0xFF
+        open(pen, "wb").write(bytes(data))
+        # reduce the last segment to a record-free stub, the shape that
+        # WOULD make an incomplete tail repairable
+        last = os.path.join(d, segs[-1])
+        with open(last, "r+b") as f:
+            f.truncate(3)
+        with pytest.raises(WALError, match="durable"):
+            WAL(d).read_all()
+    finally:
+        walmod.SEGMENT_BYTES = old
+
+
+def test_wal_bitrot_mid_final_segment_refuses(tmp_path):
+    """A complete-but-crc-broken frame with MORE records after it in the
+    final segment is bit rot on fsync'd bytes, not a torn tail — the
+    records behind it (later hardstates carrying vote/term) must not be
+    silently truncated away. Only the log's very last frame (ending at
+    EOF) gets the lenient tail treatment."""
+    from etcd_tpu.storage.walcodec import get_codec
+
+    d = str(tmp_path / "wal")
+    w = WAL(d)
+    _fill(w, 5)
+    w.close()
+    seg = os.path.join(d, sorted(os.listdir(d))[-1])
+    buf = open(seg, "rb").read()
+    first_len = get_codec().decode(buf, 0, 0)[0]
+    data = bytearray(buf)
+    data[first_len + 12] ^= 0xFF  # payload byte of frame 2 of 10
+    open(seg, "wb").write(bytes(data))
+    with pytest.raises(WALError, match="durable"):
+        WAL(d).read_all()
+
+
+def test_bootstrap_from_wal_initial_snapshot_marker(tmp_path):
+    """A WAL that opens with the initial empty-snapshot marker
+    (index 0, term 0) must still bootstrap — apply_snapshot would
+    reject index 0 as out of date on a fresh MemoryStorage."""
+    d = str(tmp_path / "wal")
+    w = WAL(d)
+    w.save_snapshot(index=0, term=0)
+    w.save({"term": 1, "vote": 0, "commit": 1},
+           [{"index": 1, "term": 1, "data": 11, "type": 0}])
+    w.close()
+    ms, _ = bootstrap_from_wal(WAL(d))
+    assert ms.first_index() == 1 and ms.last_index() == 1
+    assert ms.hard_state.commit == 1
+
+
+def test_wal_bitrot_in_debris_segment_refuses(tmp_path):
+    """The bit-rot rule applies to the segments repair would UNLINK too:
+    a tear in the penultimate segment followed by a last segment whose
+    first frame is complete but crc-broken must refuse — unlinking it
+    would silently delete durable records."""
+    import etcd_tpu.storage.wal as walmod
+    from etcd_tpu.storage.walcodec import HEADER_SIZE
+
+    d = str(tmp_path / "wal")
+    old = walmod.SEGMENT_BYTES
+    walmod.SEGMENT_BYTES = 256
+    try:
+        w = WAL(d)
+        for i in range(1, 20):
+            w.save(None, [{"index": i, "term": 1, "data": i, "type": 0}])
+        w.close()
+        segs = sorted(f for f in os.listdir(d) if f.endswith(".wal"))
+        pen = os.path.join(d, segs[-2])
+        with open(pen, "ab") as f:
+            f.truncate(os.path.getsize(pen) - 5)
+        last = os.path.join(d, segs[-1])
+        data = bytearray(open(last, "rb").read())
+        data[HEADER_SIZE + 1] ^= 0xFF  # first frame's payload: crc breaks
+        open(last, "wb").write(bytes(data))
+        with pytest.raises(WALError, match="durable"):
+            WAL(d).read_all()
+        assert os.path.exists(last)  # nothing was unlinked
+    finally:
+        walmod.SEGMENT_BYTES = old
+
+
+def test_wal_mid_log_corruption_still_refuses(tmp_path):
+    """Valid records AFTER a tear make it mid-log corruption, which must
+    stay loud (repair would create a silent hole) — the widened repair
+    path must not regress this."""
+    import etcd_tpu.storage.wal as walmod
+
+    d = str(tmp_path / "wal")
+    old = walmod.SEGMENT_BYTES
+    walmod.SEGMENT_BYTES = 256
+    try:
+        w = WAL(d)
+        for i in range(1, 20):
+            w.save(None, [{"index": i, "term": 1, "data": i, "type": 0}])
+        w.close()
+        segs = sorted(f for f in os.listdir(d) if f.endswith(".wal"))
+        pen = os.path.join(d, segs[-2])
+        with open(pen, "ab") as f:
+            f.truncate(os.path.getsize(pen) - 5)
+        with pytest.raises(WALError):
+            WAL(d).read_all()
+    finally:
+        walmod.SEGMENT_BYTES = old
+
+
+def test_crash_restart_roundtrip_through_storage(tmp_path):
+    """The full host-side crash loop: write through the WAL, crash with
+    a torn tail, bootstrap a MemoryStorage from the repaired replay, and
+    check the recovery invariants the device checkers enforce — the
+    durable prefix is intact, commit never exceeds the surviving log,
+    and the persisted term never regresses across restarts."""
+    d = str(tmp_path / "wal")
+    w = WAL(d, metadata=b"group-7")
+    _fill(w, 6, term=1)
+    w.save_snapshot(index=2, term=1)
+    w.save({"term": 2, "vote": 1, "commit": 5},
+           [{"index": 7, "term": 2, "data": 77, "type": 0}])
+    w.close()
+
+    # term monotonicity on the PERSISTED HardState: each recovery may see
+    # a torn-off (never-durable) newest batch fall away, but never a term
+    # below what an earlier recovery already read back — tears only reach
+    # the freshly appended tail, so once recovered, always recovered
+    prev_recovered_term = 0
+    rng = random.Random(5)
+    for crash in range(4):
+        seg = os.path.join(d, sorted(
+            f for f in os.listdir(d) if f.endswith(".wal"))[-1])
+        # fsync lag: lose a random sliver of the tail
+        with open(seg, "ab") as f:
+            f.truncate(max(os.path.getsize(seg) - rng.randrange(1, 30), 0))
+        w = WAL(d)
+        ms, metadata = bootstrap_from_wal(w)
+        assert metadata == b"group-7"
+        hs, _ = ms.initial_state()
+        assert hs.commit <= ms.last_index()
+        assert hs.term >= prev_recovered_term, "persisted term regressed"
+        prev_recovered_term = hs.term
+        assert ms.snapshot().meta.index == 2
+        assert ms.first_index() == 3  # replay starts past the snapshot
+        # log matching across restart: entry terms stay non-decreasing
+        terms = [ms.term(i)
+                 for i in range(ms.first_index(), ms.last_index() + 1)]
+        assert terms == sorted(terms)
+        # the restarted node keeps writing at a strictly higher term
+        t = hs.term + 1
+        nxt = ms.last_index() + 1
+        w.save({"term": t, "vote": 0, "commit": hs.commit},
+               [{"index": nxt, "term": t, "data": nxt, "type": 0}])
+        w.close()
+
+    # final intact replay still bootstraps
+    ms, _ = bootstrap_from_wal(WAL(d))
+    assert ms.last_index() >= ms.hard_state.commit

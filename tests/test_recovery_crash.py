@@ -1,0 +1,235 @@
+"""Crash–restart chaos tier (ISSUE 3): the SIGKILL/restart fault class of
+the reference's functional tester (tester/case_sigterm.go + snapshot
+cases) run on-device, with the fsync-lag durability model and the
+recovery-invariant checkers (leader completeness, log matching across
+restart, HardState term monotonicity).
+
+The default tests run a tiny fleet on CPU (<=64 groups, <=2 fault
+epochs — the run_smoke.sh configuration); the 262k bench-geometry run
+rides behind the `slow` marker and chaos_run.py (CHAOS_CRASH=0.01).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from etcd_tpu.harness.chaos import (
+    VIOLATION_KEYS,
+    run_chaos,
+    summarize_chaos,
+)
+from etcd_tpu.models.engine import (
+    crash_restart_fleet,
+    init_fleet,
+    wipe_crashed_traffic,
+    empty_inbox,
+)
+from etcd_tpu.models.state import (
+    CAPPED_FIELDS,
+    DURABLE_FIELDS,
+    NodeState,
+    REPLAY_FIELDS,
+    VOLATILE_FIELDS,
+)
+from etcd_tpu.types import NONE_ID, ROLE_FOLLOWER, ROLE_LEADER, Spec
+from etcd_tpu.utils.config import CrashConfig, RaftConfig
+
+SPEC = Spec(M=5, L=32, E=2, K=4, W=2, R=2, A=4)
+CFG = RaftConfig(pre_vote=True, check_quorum=True)
+
+
+def assert_safe(rep):
+    for k in VIOLATION_KEYS:
+        assert rep[k] == 0, rep
+
+
+def test_chaos_crash_restart_small_fleet():
+    """Seeded small-fleet run with crash faults stacked on the network
+    mix: all six checkers stay zero, the fleet recovers, and crashes
+    actually happened (the fault class is live, not vacuously safe)."""
+    rep = run_chaos(
+        SPEC, CFG, C=16, rounds=50, epoch_len=25, heal_len=25, seed=1,
+        drop_p=0.03, delay_p=0.08, partition_p=0.2,
+        crash_p=0.04, crash=CrashConfig(down_rounds=2),
+    )
+    assert_safe(rep)
+    assert rep["crashes_injected"] > 0
+    # every injected crash restarts: crashes only inject in fault
+    # epochs and the run always ends on a heal epoch whose length (25)
+    # exceeds down_rounds (2), so no down-timer survives to the end
+    assert rep["restarts_completed"] == rep["crashes_injected"]
+    summary = summarize_chaos(rep, rounds=50, epoch_len=25, heal_len=25)
+    assert summary["safe"] and summary["recovered"] and summary["lively"], (
+        rep, summary)
+
+
+def test_chaos_crash_persist_nothing_fires_checker():
+    """The deliberately-broken durability model (persist nothing past the
+    snapshot) must trip the leader-completeness checker: enough crashes
+    drop a committed index below quorum holdership. Proves the checker
+    is live — a chaos tier whose checkers cannot fire proves nothing.
+
+    Deliberately the SAME cfg/spec/epoch geometry (and delay_p > 0) as
+    the honest-model test above: the durability knobs are runtime
+    operands, so this run reuses the epoch programs that test already
+    traced (harness/chaos.py _epoch_program) instead of paying a second
+    ~60s trace in the smoke tier."""
+    rep = run_chaos(
+        SPEC, CFG, C=16, rounds=25, epoch_len=25, heal_len=25, seed=3,
+        drop_p=0.0, delay_p=0.08, partition_p=0.0,
+        crash_p=0.12, crash=CrashConfig(down_rounds=2, durability="none"),
+    )
+    assert rep["lost_commit"] > 0, rep
+
+
+def test_crash_restart_fleet_field_classification():
+    """The wipe implements models/state.py's durability table exactly,
+    field by field — and the table covers every NodeState field, so a
+    future field cannot silently survive (or lose) a simulated crash."""
+    all_fields = set(NodeState.__dataclass_fields__)
+    classified = (set(DURABLE_FIELDS) | set(CAPPED_FIELDS)
+                  | set(REPLAY_FIELDS) | set(VOLATILE_FIELDS))
+    assert classified == all_fields, classified ^ all_fields
+    assert len(DURABLE_FIELDS + CAPPED_FIELDS + REPLAY_FIELDS
+               + VOLATILE_FIELDS) == len(all_fields)  # no double-class
+
+    spec = SPEC
+    C = 4
+    state = init_fleet(spec, C, seed=9)
+    # dirty every volatile/derived field so "reset" is distinguishable
+    ones2 = jnp.ones_like(state.commit)
+    state = state.replace(
+        term=state.term + 4, vote=jnp.zeros_like(state.vote),
+        commit=ones2 * 6, last_index=ones2 * 8, applied=ones2 * 5,
+        applied_hash=ones2 * 1234, snap_index=ones2 * 2,
+        snap_term=ones2 * 3, snap_hash=ones2 * 77,
+        role=jnp.full_like(state.role, ROLE_LEADER),
+        lead=jnp.zeros_like(state.lead),
+        election_elapsed=ones2 * 3, heartbeat_elapsed=ones2 * 1,
+        match=jnp.ones_like(state.match) * 7,
+        next_idx=jnp.ones_like(state.next_idx) * 9,
+        votes_granted=jnp.ones_like(state.votes_granted),
+        uncommitted_size=ones2 * 2,
+        ro_count=ones2 * 1,
+    )
+    crashed = jnp.ones((spec.M, C), jnp.bool_).at[0, 0].set(False)
+    stable = ones2 * 7           # one entry (index 8) past the fsync floor
+    rand_to = ones2 * 13
+    out, lost = crash_restart_fleet(spec, state, crashed, stable, rand_to)
+
+    g = lambda s, name: np.asarray(getattr(s, name))
+    # DURABLE: untouched everywhere
+    for f in DURABLE_FIELDS:
+        np.testing.assert_array_equal(g(out, f), g(state, f), err_msg=f)
+    # CAPPED: last_index drops to stable (> snap floor here), commit
+    # follows; the uncrashed lane keeps its originals
+    assert g(out, "last_index")[0, 0] == 8
+    assert g(out, "commit")[0, 0] == 6
+    assert (g(out, "last_index")[:, 1:] == 7).all()
+    assert (g(out, "commit")[:, 1:] == 6).all()
+    # entries_lost: one entry per crashed node
+    assert int(lost) == int(np.asarray(crashed).sum())
+    # REPLAY: rewound to the snapshot cursor/ConfState
+    assert (g(out, "applied")[:, 1:] == 2).all()
+    assert (g(out, "applied_hash")[:, 1:] == 77).all()
+    np.testing.assert_array_equal(
+        g(out, "voters")[:, :, 1:], g(state, "snap_voters")[:, :, 1:])
+    # VOLATILE: fresh-follower boot values (randomized_timeout re-drawn
+    # from the supplied draw)
+    assert (g(out, "role")[:, 1:] == ROLE_FOLLOWER).all()
+    assert (g(out, "lead")[:, 1:] == NONE_ID).all()
+    assert (g(out, "election_elapsed")[:, 1:] == 0).all()
+    assert (g(out, "randomized_timeout")[:, 1:] == 13).all()
+    assert (g(out, "match")[:, :, 1:] == 0).all()
+    assert (g(out, "next_idx")[:, :, 1:] == 8).all()  # durable_last + 1
+    assert (g(out, "votes_granted")[:, :, 1:] == 0).all()
+    assert (g(out, "uncommitted_size")[:, 1:] == 0).all()
+    assert (g(out, "ro_count")[:, 1:] == 0).all()
+    # the uncrashed lane (m=0, c=0) kept ALL its volatile state
+    assert g(out, "role")[0, 0] == ROLE_LEADER
+    assert g(out, "match")[0, :, 0].max() == 7
+
+    # persist-nothing drops the log to the snapshot outright
+    out2, lost2 = crash_restart_fleet(
+        spec, state, crashed, stable, rand_to, keep_log=False)
+    assert (g(out2, "last_index")[:, 1:] == 2).all()
+    assert (g(out2, "commit")[:, 1:] == 2).all()
+    assert int(lost2) == 6 * int(np.asarray(crashed).sum())
+
+
+def test_wipe_crashed_traffic_kills_rows_and_cols():
+    spec = SPEC
+    C = 3
+    inbox = empty_inbox(spec, C)
+    t = jnp.ones_like(inbox.type)  # every slot carries a message
+    inbox = inbox.replace(type=t)
+    crashed = jnp.zeros((spec.M, C), jnp.bool_).at[2, 1].set(True)
+    out = wipe_crashed_traffic(spec, inbox, crashed)
+    t5 = np.asarray(out.type).reshape(spec.M, spec.K, spec.M, C)
+    assert (t5[2, :, :, 1] == 0).all()   # everything FROM node 2, lane 1
+    assert (t5[:, :, 2, 1] == 0).all()   # everything TO node 2, lane 1
+    # all other traffic survives
+    mask = np.ones_like(t5, bool)
+    mask[2, :, :, 1] = False
+    mask[:, :, 2, 1] = False
+    assert (t5[mask] == 1).all()
+
+
+def test_summarize_chaos_gates():
+    base = {
+        "groups": 10,
+        "multi_leader": 0, "hash_mismatch": 0, "commit_regress": 0,
+        "lost_commit": 0, "log_divergence": 0, "term_regress": 0,
+        "groups_with_leader_after_heal": 10,
+        "heal_commits_last_epoch": 5,
+        # two fault epochs + one WaitHealth extension row (must not
+        # count toward the fault-epoch liveness floor)
+        "epoch_commits": [(120, 300), (80, 250), (0, 40)],
+    }
+    s = summarize_chaos(base, rounds=150, epoch_len=50, heal_len=25)
+    assert s["safe"] and s["recovered"]
+    assert s["faulted_commits"] == 200
+    assert s["faulted_liveness_floor"] == int(0.2 * 10 * 100)
+    assert s["lively"]
+
+    # any recovery-invariant counter breaks "safe"
+    s2 = summarize_chaos({**base, "lost_commit": 1},
+                         rounds=150, epoch_len=50, heal_len=25)
+    assert not s2["safe"]
+    # a report from a pre-crash-tier driver (no new keys) still gates
+    legacy = {k: v for k, v in base.items()
+              if k not in ("lost_commit", "log_divergence", "term_regress")}
+    assert summarize_chaos(legacy, rounds=150, epoch_len=50,
+                           heal_len=25)["safe"]
+    # a wedged fleet fails the liveness floor
+    s3 = summarize_chaos({**base, "epoch_commits": [(3, 300), (2, 250)]},
+                         rounds=150, epoch_len=50, heal_len=25)
+    assert not s3["lively"]
+    # missing leader after heal fails recovery
+    s4 = summarize_chaos({**base, "groups_with_leader_after_heal": 9},
+                         rounds=150, epoch_len=50, heal_len=25)
+    assert not s4["recovered"]
+
+
+def test_crash_chaos_rejects_singleton():
+    with pytest.raises(ValueError, match="M >= 2"):
+        run_chaos(Spec(M=1, L=8, E=1, K=1, W=2, R=2, A=2), CFG, C=4,
+                  rounds=10, crash_p=0.1)
+
+
+@pytest.mark.slow
+def test_chaos_crash_262k_groups():
+    """The acceptance-scale run (bench geometry, crash faults stacked on
+    the standard network mix) — exercised on TPU via chaos_run.py
+    (CHAOS_C=262144 CHAOS_CRASH=0.01); here behind the slow marker."""
+    spec = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                     inbox_bound=4, coalesce_commit_refresh=True,
+                     wire_int16=True)
+    rep = run_chaos(
+        spec, cfg, C=262_144, rounds=200, epoch_len=50, heal_len=25,
+        seed=0, drop_p=0.02, delay_p=0.05, partition_p=0.1,
+        crash_p=0.01, crash=CrashConfig(down_rounds=3),
+    )
+    assert_safe(rep)
+    s = summarize_chaos(rep, rounds=200, epoch_len=50, heal_len=25)
+    assert s["recovered"] and s["lively"], (rep, s)
