@@ -2,9 +2,10 @@
 
 Runs the functional chaos loop (etcd_tpu/harness/chaos.py) at
 CHAOS_C groups x CHAOS_ROUNDS rounds with randomized drop/delay/partition
-(and, with CHAOS_CRASH > 0, crash–restart) faults and on-device safety
-checkers, then prints ONE JSON line with the violation counts and
-liveness stats. Evidence files: CHAOS_r*.json.
+(and, with CHAOS_CRASH > 0, crash–restart; with CHAOS_MEMBER > 0,
+membership-change) faults and on-device safety checkers, then prints ONE
+JSON line with the violation counts and liveness stats. Evidence files:
+CHAOS_r*.json / CHAOS_CRASH_*.json / CHAOS_MEMBER_*.json.
 
 Usage: CHAOS_C=1000000 CHAOS_ROUNDS=200 python chaos_run.py
 Crash tier: CHAOS_C=262144 CHAOS_CRASH=0.01 python chaos_run.py
@@ -12,6 +13,21 @@ Crash tier: CHAOS_C=262144 CHAOS_CRASH=0.01 python chaos_run.py
   selects the deliberately-broken persist-nothing model, which MUST
   trip the leader-completeness checker — useful to prove the checker
   is live at scale.)
+Membership tier: CHAOS_C=4096 CHAOS_CRASH=0.01 CHAOS_MEMBER=0.05 \\
+  python chaos_run.py
+  (CHAOS_MEMBER_MIX names the conf-change palette — standard / simple /
+  shrink; CHAOS_INIT_VOTERS boots partial voter sets, default 3 when the
+  tier is on; CHAOS_SNAP_BOOST / CHAOS_MEMBER_BOOST route the crash
+  budget through the targeted scheduler, 1 = plain Bernoulli;
+  CHAOS_CONFIG_AWARE=0 selects the deliberately config-blind recovery
+  checkers, which MUST fire on a remove-voter schedule. Conf-change
+  words exceed the int16 wire, so the tier forces CHAOS_WIRE16=0, and
+  the liveness floor defaults to the tier's conscious 0.1 instead of
+  0.2 — membership churn legally starves fault epochs harder.)
+
+All knobs are validated up front: a probability outside [0, 1], a boost
+below 1, or an unknown mix/durability name exits 2 before any device
+work.
 """
 from __future__ import annotations
 
@@ -21,6 +37,26 @@ import sys
 import time
 
 import jax
+
+
+def _knob_error(msg: str) -> "NoReturn":  # noqa: F821 — py3.9 compat
+    print(f"chaos_run: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def _env_float(name: str, default: str, lo: float | None = None,
+               hi: float | None = None) -> float:
+    raw = os.environ.get(name, default)
+    try:
+        v = float(raw)
+    except ValueError:
+        _knob_error(f"{name}={raw!r} is not a number")
+    if v != v:  # NaN compares False against any range bound
+        _knob_error(f"{name}={raw!r} is not a number")
+    if lo is not None and v < lo or hi is not None and v > hi:
+        span = (f"[{lo}, {hi}]" if hi is not None else f">= {lo}")
+        _knob_error(f"{name}={raw} outside {span}")
+    return v
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
@@ -40,7 +76,59 @@ configure_compile_cache(os.path.dirname(os.path.abspath(__file__)))
 def main() -> int:
     from etcd_tpu.harness.chaos import run_chaos, summarize_chaos
     from etcd_tpu.types import Spec
-    from etcd_tpu.utils.config import CrashConfig, RaftConfig
+    from etcd_tpu.utils.config import (
+        CrashConfig,
+        MemberChaosConfig,
+        RaftConfig,
+    )
+
+    # ---- knob validation, before any device work (exit code 2).
+    # Name/shape validation is delegated to the config dataclasses' own
+    # __post_init__ (one source of truth: adding a mix or durability
+    # mode there is automatically accepted here); this block only owns
+    # the env parsing and the numeric range checks.
+    drop_p = _env_float("CHAOS_DROP", "0.02", 0.0, 1.0)
+    delay_p = _env_float("CHAOS_DELAY", "0.05", 0.0, 1.0)
+    partition_p = _env_float("CHAOS_PART", "0.1", 0.0, 1.0)
+    crash_p = _env_float("CHAOS_CRASH", "0", 0.0, 1.0)
+    member_p = _env_float("CHAOS_MEMBER", "0", 0.0, 1.0)
+    snap_boost = _env_float("CHAOS_SNAP_BOOST", "1", 1.0)
+    member_boost = _env_float("CHAOS_MEMBER_BOOST", "1", 1.0)
+    # the membership tier's conscious liveness floor is 0.1 (joint
+    # configs need both halves to commit; partial-voter boots leave
+    # partitioned minorities smaller) — see README chaos tiers
+    liveness_frac = _env_float(
+        "CHAOS_LIVENESS_FRAC", "0.1" if member_p > 0 else "0.2", 0.0, 1.0)
+    raw_iv = os.environ.get("CHAOS_INIT_VOTERS",
+                            "3" if member_p > 0 else "0")
+    try:
+        init_voters = int(raw_iv)
+    except ValueError:
+        _knob_error(f"CHAOS_INIT_VOTERS={raw_iv!r} is not an integer")
+    try:
+        down_rounds = int(os.environ.get("CHAOS_DOWN", "3"))
+    except ValueError:
+        _knob_error(f"CHAOS_DOWN={os.environ['CHAOS_DOWN']!r} is not an "
+                    "integer")
+    try:
+        crash_knobs = CrashConfig(
+            down_rounds=down_rounds,
+            durability=os.environ.get("CHAOS_DURABILITY", "stable"),
+        )
+        member_cfg = MemberChaosConfig(
+            mix=os.environ.get("CHAOS_MEMBER_MIX", "standard"),
+            initial_voters=init_voters,
+            snap_crash_boost=snap_boost,
+            member_crash_boost=member_boost,
+        )
+    except ValueError as e:
+        _knob_error(str(e))
+    env_w16 = os.environ.get("CHAOS_WIRE16")
+    if member_p > 0 and env_w16 is not None and env_w16 != "0":
+        # same truthiness rule as the parse below — any non-"0" value
+        # asks for the int16 wire, which cc words cannot ride
+        _knob_error("CHAOS_MEMBER needs the int32 wire (conf-change words "
+                    "use bits 16-20); unset CHAOS_WIRE16")
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
@@ -55,8 +143,16 @@ def main() -> int:
     # transport contract already drops via keep-masks), and it is counted.
     L = int(os.environ.get("CHAOS_L", "16"))
     spec = Spec(M=5, L=L, E=1, K=2, W=4, R=2, A=2)
+    if init_voters > spec.M:
+        # silently collapsing to the all-voters boot would defeat the
+        # partial-voter-set intent (no free slots for add words)
+        _knob_error(f"CHAOS_INIT_VOTERS={init_voters} exceeds the member "
+                    f"count M={spec.M}")
     bound = int(os.environ.get("CHAOS_BOUND", str(spec.M - 1)))
-    wire16 = os.environ.get("CHAOS_WIRE16", "1") != "0"
+    # the membership tier needs the int32 wire (validated above): its
+    # conf-change words ride MsgProp/MsgApp ent_data and use bits 16-20
+    wire16 = (os.environ.get("CHAOS_WIRE16", "1") != "0"
+              and member_p == 0)
     # fleet chunking caps the round program's HLO temporaries, exactly as
     # in bench.py — above ~262k resident groups the un-chunked chaos
     # round overflows HBM by mere tens of MB. Chunks of 131,072 (the
@@ -72,20 +168,19 @@ def main() -> int:
 
     epoch_len, heal_len = 50, 25
     # crash–restart faults (CrashConfig durability model): off by default
-    # so the legacy network-fault evidence runs stay bit-identical
-    crash_p = float(os.environ.get("CHAOS_CRASH", "0"))
-    crash_cfg = CrashConfig(
-        down_rounds=int(os.environ.get("CHAOS_DOWN", "3")),
-        durability=os.environ.get("CHAOS_DURABILITY", "stable"),
-    ) if crash_p > 0 else None
+    # so the legacy network-fault evidence runs stay bit-identical.
+    # crash_knobs/member_cfg were validated up front; member_cfg is
+    # always passed — its crash-boost knobs target snapshot windows in
+    # pure crash runs too (run_chaos gates the palette on member_p)
+    crash_cfg = crash_knobs if crash_p > 0 else None
     t0 = time.perf_counter()
     rep = run_chaos(
         spec, cfg, C=C, rounds=rounds, epoch_len=epoch_len, heal_len=heal_len,
         seed=int(os.environ.get("CHAOS_SEED", "0")),
-        drop_p=float(os.environ.get("CHAOS_DROP", "0.02")),
-        delay_p=float(os.environ.get("CHAOS_DELAY", "0.05")),
-        partition_p=float(os.environ.get("CHAOS_PART", "0.1")),
+        drop_p=drop_p, delay_p=delay_p, partition_p=partition_p,
         crash_p=crash_p, crash=crash_cfg,
+        member_p=member_p, member=member_cfg,
+        config_aware=os.environ.get("CHAOS_CONFIG_AWARE", "1") != "0",
         sync_dispatch=os.environ.get("CHAOS_SYNC", "0") != "0",
     )
     rep["elapsed_s"] = round(time.perf_counter() - t0, 1)
@@ -94,7 +189,7 @@ def main() -> int:
     # the same pure function the tests drive)
     rep.update(summarize_chaos(
         rep, rounds=rounds, epoch_len=epoch_len, heal_len=heal_len,
-        liveness_frac=float(os.environ.get("CHAOS_LIVENESS_FRAC", "0.2")),
+        liveness_frac=liveness_frac,
     ))
 
     # host-layer lease chaos (tester/stresser_lease.go +

@@ -27,6 +27,20 @@ The TPU-native equivalent runs the whole loop ON DEVICE at fleet scale:
     re-derives applied state by replaying its durable log from the
     snapshot. See utils/config.py CrashConfig and the durability
     classification table in models/state.py.
+  * membership-change faults (the tester's member add/remove cases):
+    encoded conf-change proposals — add/remove voter, add learner,
+    promote, auto-joint two-delta words — injected at node 0 with
+    per-round Bernoulli probability ``member_p``, sampled from an i32
+    palette that rides as a RUNTIME operand (one trace serves every
+    mix). Leader-side proposal-guard outcomes and applied-config
+    transitions are counted in CrashMetrics;
+  * targeted crash scheduling: instead of spreading the crash budget
+    Bernoulli-uniformly, the scheduler detects the snapshot-install
+    window (MsgSnap in flight / leader pre-ack in PR_SNAPSHOT) and the
+    membership-sensitive window (joint config / committed-but-unapplied
+    conf change) per node-round and concentrates the SAME expected crash
+    budget there (engine.snapshot_window_mask / member_window_mask +
+    targeted_crash_probs);
   * checkers, evaluated every round as tensor reductions and accumulated
     as violation counters so only a handful of scalars ever cross to the
     host:
@@ -36,11 +50,17 @@ The TPU-native equivalent runs the whole loop ON DEVICE at fleet scale:
       - commit monotonicity: no node's commit index ever regresses
         (crash rounds are exempt for the crashed nodes — commit-only
         advances are never fsync'd, so a restart legally regresses it);
-      - leader completeness: no index the group has ever committed stops
-        being durably held by a quorum (a crash that dropped holders
-        below quorum could elect a leader missing committed entries);
-      - log matching across restart: every member that can still read
-        the group's minimum commit index agrees on its term;
+      - leader completeness, CONFIG-AWARE: no index the group has ever
+        committed may become erasable by an election under the group's
+        live (possibly joint) configuration — per half, the non-holders
+        must never form a quorum on their own (see
+        check_recovery_invariants for the intersection-bar form and the
+        config-blind broken variant);
+      - log matching across restart: every TRACKED member that can still
+        read the tracked set's minimum commit index agrees on its term
+        (members outside the live config abstain — a removed voter's
+        stale cursor must not pin the probe, and a never-added slot
+        would hold it at zero forever);
       - term monotonicity on the persisted HardState: term never moves
         backwards, crash or not (term/vote changes fsync before any
         message reflecting them is sent).
@@ -61,6 +81,8 @@ from etcd_tpu.models.engine import (
     crash_restart_fleet,
     empty_inbox,
     init_fleet,
+    member_window_mask,
+    snapshot_window_mask,
     wipe_crashed_traffic,
 )
 from etcd_tpu.models.metrics import (
@@ -69,8 +91,21 @@ from etcd_tpu.models.metrics import (
     zero_crash_metrics,
 )
 from etcd_tpu.models.state import NodeState
-from etcd_tpu.types import Msg, ROLE_LEADER, Spec
-from etcd_tpu.utils.config import CrashConfig, RaftConfig
+from etcd_tpu.types import (
+    CC_ADD_LEARNER,
+    CC_ADD_NODE,
+    CC_REMOVE_NODE,
+    ENTRY_CONF_CHANGE,
+    INT32_MAX,
+    Msg,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import (
+    CrashConfig,
+    MemberChaosConfig,
+    RaftConfig,
+)
 
 
 class Violations(struct.PyTreeNode):
@@ -119,55 +154,206 @@ def check_invariants(state: NodeState, prev_commit: jnp.ndarray,
     )
 
 
-def check_recovery_invariants(
-    spec: Spec, state: NodeState, watermark: jnp.ndarray,
-    prev_term: jnp.ndarray, viol: Violations, quorum: int,
-) -> tuple[Violations, jnp.ndarray]:
-    """Crash-recovery checkers (ISSUE 3), as per-round tensor reductions.
+def refresh_ref_config(state: NodeState, crash: "CrashState") -> "CrashState":
+    """Adopt the newest APPLIED configuration as each group's reference
+    config for the recovery checkers.
 
-    ``watermark`` [C] is the running max index each group has ever
-    committed; the updated watermark is returned for the scan carry.
-    ``quorum`` is the static majority of the full member set — the crash
-    tier runs fixed all-voter fleets (membership-change chaos is a
-    ROADMAP open item).
+    Conf changes are log entries, so the member with the highest applied
+    index holds the newest applied config (equal applied => equal entries
+    => equal config, the same argument as the KV_HASH checker). The carry
+    is keyed by a config EPOCH (``ref_applied``, the applied index the
+    reference was captured at): a crash rewinds the crashed node's
+    applied view to its snapshot's ConfState, and a round where every
+    up-to-date member is down must NOT regress the checker to a stale
+    config — the conf entries are still in the durable logs and will
+    re-apply, so the newest-ever applied config stays authoritative
+    across the outage.
+    """
+    best = state.applied.max(axis=0)                       # [C]
+    is_best = state.applied == best[None, :]               # [M, C]
+    # lowest-id tie-break makes `first` a one-hot selector
+    first = is_best & (jnp.cumsum(is_best, axis=0) == 1)
+
+    def pick(mask):  # [M(node), M(id), C] -> the best node's [M(id), C]
+        return (first[:, None, :] & mask).any(axis=0)
+
+    tracked = (state.voters | state.voters_out | state.learners
+               | state.learners_next)
+    adopt = (best >= crash.ref_applied)[None, :]           # [1, C]
+    return crash.replace(
+        ref_voters=jnp.where(adopt, pick(state.voters), crash.ref_voters),
+        ref_voters_out=jnp.where(
+            adopt, pick(state.voters_out), crash.ref_voters_out),
+        ref_tracked=jnp.where(adopt, pick(tracked), crash.ref_tracked),
+        ref_applied=jnp.maximum(crash.ref_applied, best),
+    )
+
+
+def check_recovery_invariants(
+    spec: Spec, state: NodeState, crash: "CrashState", viol: Violations,
+    config_aware,
+) -> tuple[Violations, "CrashState"]:
+    """Config-aware crash-recovery checkers (ISSUE 3 + ISSUE 5), as
+    per-round tensor reductions; returns (viol, crash) with the
+    watermark / term-baseline / reference-config carries refreshed.
+
+    Leader completeness is evaluated against the group's live — possibly
+    joint — configuration (refresh_ref_config), not a static full-member
+    majority: a committed index is LOST iff a candidate missing it could
+    still win an election, i.e. iff in the incoming half (and, when
+    joint, ALSO in the outgoing half — joint elections must win both,
+    quorum/joint.go:49-68) the durable non-holders form a majority on
+    their own. For an all-voter odd-M config this reduces exactly to the
+    old ``holders < M//2 + 1`` bar; for even-sized halves the
+    intersection bar is one looser (2 holders of 4 voters already
+    intersect every 3-vote quorum), and removed voters simply drop out
+    of both halves instead of counting as missing holders.
+
+    ``config_aware`` is a RUNTIME operand: False selects the deliberately
+    config-blind variant — the pre-ISSUE-5 static full-member majority
+    with every member slot tracked — which MUST fire on a remove-voter
+    schedule the config-aware checker accepts (the proof the rework is
+    live, mirroring the persist-nothing durability mode).
     """
     M = spec.M
+    crash = refresh_ref_config(state, crash)
     # term monotonicity on the persisted HardState: term/vote fsync
     # before any message reflecting them leaves the node, so nothing —
     # crash included — may move a node's term backwards
-    t_reg = (state.term < prev_term).sum().astype(jnp.int32)
+    t_reg = (state.term < crash.prev_term).sum().astype(jnp.int32)
 
     # leader completeness: every index the group has ever committed must
-    # remain durably held by >= quorum members (last_index covers
-    # snapshot holders: last_index >= snap_index always), or an election
-    # among the non-holders could erase a committed entry
-    wm = jnp.maximum(watermark, state.commit.max(axis=0))        # [C]
-    holders = (state.last_index >= wm[None, :]).sum(axis=0)      # [C]
-    lost = ((holders < quorum) & (wm > 0)).sum().astype(jnp.int32)
+    # stay election-safe under the reference config (last_index covers
+    # snapshot holders: last_index >= snap_index always)
+    wm = jnp.maximum(crash.watermark, state.commit.max(axis=0))  # [C]
+    holders = state.last_index >= wm[None, :]                    # [M, C]
 
-    # log matching across restart, probed at the group's committed
-    # frontier: all members that can still read min-commit agree on its
-    # term. Members compacted past it abstain; snapshot-boundary holders
-    # answer with snap_term (same rule as ops/log.py term_at).
-    mc = state.commit.min(axis=0)                                 # [C]
+    def electable_without(half):
+        """Could a candidate missing wm win this majority half? Yes iff
+        the half's non-holders reach its quorum by themselves (a holder
+        never grants to a candidate whose log misses wm)."""
+        nv = half.sum(axis=0).astype(jnp.int32)                  # [C]
+        non = (half & ~holders).sum(axis=0).astype(jnp.int32)    # [C]
+        return (nv > 0) & (non >= nv // 2 + 1)
+
+    out_empty = ~crash.ref_voters_out.any(axis=0)                # [C]
+    erasable = electable_without(crash.ref_voters) & (
+        out_empty | electable_without(crash.ref_voters_out))
+    # config-blind variant: static majority of ALL M member slots
+    blind = holders.sum(axis=0) < (M // 2 + 1)
+    lost_mask = jnp.where(config_aware, erasable, blind) & (wm > 0)
+    lost = lost_mask.sum().astype(jnp.int32)
+
+    # log matching across restart, probed at the TRACKED members'
+    # committed frontier: all tracked members that can still read the
+    # tracked min-commit agree on its term. Untracked members abstain
+    # (a removed voter's stale commit must not pin the probe; a
+    # never-added slot would hold it at 0 forever); members compacted
+    # past it abstain; snapshot-boundary holders answer with snap_term
+    # (same rule as ops/log.py term_at).
+    tracked = jnp.where(config_aware, crash.ref_tracked,
+                        jnp.ones_like(crash.ref_tracked))
+    mc = jnp.where(tracked, state.commit, INT32_MAX).min(axis=0)  # [C]
     L = state.log_term.shape[1]
     oh = jnp.arange(L, dtype=jnp.int32)[:, None] == (mc - 1) % L  # [L, C]
     t_ring = (state.log_term * oh[None, :, :]).sum(axis=1)        # [M, C]
     t_mc = jnp.where(mc[None, :] == state.snap_index, state.snap_term, t_ring)
-    can_read = (mc[None, :] >= state.snap_index) & (mc[None, :] > 0)
+    can_read = tracked & (mc[None, :] >= state.snap_index) & (
+        mc[None, :] > 0) & (mc[None, :] < INT32_MAX)
     iu, ju = jnp.triu_indices(M, k=1)
     diverged = (t_mc[iu] != t_mc[ju]) & can_read[iu] & can_read[ju]
 
-    return viol.replace(
+    viol = viol.replace(
         term_regress=viol.term_regress + t_reg,
         lost_commit=viol.lost_commit + lost,
         log_divergence=viol.log_divergence
         + diverged.sum().astype(jnp.int32),
-    ), wm
+    )
+    return viol, crash.replace(watermark=wm, prev_term=state.term)
+
+
+def member_palette(spec: Spec, mix: str = "standard") -> jnp.ndarray:
+    """The conf-change words the membership tier injects, as an i32[P]
+    RUNTIME operand of the epoch program (utils/config.py MEMBER_MIXES).
+
+    Words only ever remove/demote members with id >= 2 — the fsync-lag
+    crash model needs >= 2 voters (run_chaos's M >= 2 guard), and the
+    device path applies committed changes unconditionally (validation is
+    the proposer's job, models/confchange.py), so the palette is where
+    the voter floor is enforced. Removing a non-member / re-adding a
+    member are deliberate no-op/idempotent words: they exercise the
+    guard and apply paths without changing the config.
+
+      * "simple":   single-delta add-voter / remove-voter / add-learner
+                    (promotion = add-voter on a learner) per id >= 2;
+      * "standard": "simple" plus auto-joint two-delta words (add+add,
+                    remove+remove, add+remove, learner+learner) with
+                    auto_leave set — the V2 "more than one change =>
+                    joint" rule, entering and leaving joint configs;
+      * "shrink":   remove-voter words only — the schedule the
+                    config-blind checker variant must fire on while the
+                    config-aware checker accepts it.
+    """
+    from etcd_tpu.models.confchange import encode
+
+    ids = list(range(2, spec.M))
+    if not ids:
+        raise ValueError("member chaos needs spec.M >= 3 (ids 0/1 are the "
+                         "never-removed voter floor)")
+    if mix == "shrink":
+        words = [encode([(CC_REMOVE_NODE, i)]) for i in ids]
+    else:
+        words = []
+        for i in ids:
+            words += [
+                encode([(CC_ADD_NODE, i)]),
+                encode([(CC_REMOVE_NODE, i)]),
+                encode([(CC_ADD_LEARNER, i)]),
+            ]
+        if mix == "standard" and len(ids) >= 2:
+            a, b = ids[-2], ids[-1]
+            words += [
+                encode([(CC_ADD_NODE, a), (CC_ADD_NODE, b)]),
+                encode([(CC_REMOVE_NODE, a), (CC_REMOVE_NODE, b)]),
+                encode([(CC_ADD_NODE, a), (CC_REMOVE_NODE, b)]),
+                encode([(CC_ADD_LEARNER, a), (CC_ADD_LEARNER, b)]),
+            ]
+    return jnp.asarray(words, jnp.int32)
+
+
+def targeted_crash_probs(crash_p, snap_win, mem_win, snap_boost,
+                         member_boost) -> jnp.ndarray:
+    """Per-lane crash probabilities concentrating the SAME expected crash
+    budget (crash_p * lanes) on the fault windows.
+
+    Window lanes get ``crash_p * boost`` (snapshot window wins a lane in
+    both); the remainder of the budget spreads uniformly over the
+    out-of-window lanes. If the boosted windows alone would overspend the
+    budget, both tier probabilities scale down so the round's expected
+    crash count stays exactly ``crash_p * lanes`` — the equal-budget
+    property the targeting acceptance compares against Bernoulli
+    scheduling (boosts = 1 reproduce it: every lane gets crash_p).
+    All inputs are runtime operands/tensors; shapes [M, C] bool.
+    """
+    lanes = snap_win.size
+    budget = crash_p * lanes
+    mem_only = mem_win & ~snap_win
+    w_s = snap_win.sum().astype(jnp.float32)
+    w_m = mem_only.sum().astype(jnp.float32)
+    p_s = jnp.minimum(crash_p * snap_boost, 1.0)
+    p_m = jnp.minimum(crash_p * member_boost, 1.0)
+    spend = p_s * w_s + p_m * w_m
+    scale = jnp.where(spend > budget, budget / jnp.maximum(spend, 1e-9), 1.0)
+    p_s = p_s * scale
+    p_m = p_m * scale
+    rest = jnp.maximum(lanes - w_s - w_m, 1.0)
+    p_base = jnp.clip((budget - p_s * w_s - p_m * w_m) / rest, 0.0, 1.0)
+    return jnp.where(snap_win, p_s, jnp.where(mem_only, p_m, p_base))
 
 
 class CrashState(struct.PyTreeNode):
-    """Scan-carried crash bookkeeping (all leaves small next to the log).
+    """Scan-carried crash/recovery bookkeeping (all leaves small next to
+    the log).
 
     ``stable`` is each node's durable log floor: its last_index as of the
     top of the PREVIOUS round. The one-round lag is the modeled fsync
@@ -176,23 +362,39 @@ class CrashState(struct.PyTreeNode):
     r+1, so by the time any peer has observed the ack (top of round r+2)
     those entries are at or below the crash floor — and a crash at round
     r+1 wipes the still-in-flight ack together with the entries.
+
+    The ``ref_*`` leaves carry each group's reference configuration for
+    the config-aware recovery checkers: the newest APPLIED config's
+    voter / outgoing-voter / tracked-member masks and the applied index
+    ("config epoch") they were captured at — kept across crash rewinds
+    by refresh_ref_config so a mass outage cannot regress the checker to
+    a stale membership view.
     """
 
     stable: jnp.ndarray     # [M, C] i32 durable log floor
     down: jnp.ndarray       # [M, C] i32 rounds of down-time left (0 = up)
     watermark: jnp.ndarray  # [C] i32 running max committed index
     prev_term: jnp.ndarray  # [M, C] i32 term-monotonicity baseline
+    ref_voters: jnp.ndarray      # [M, C] bool reference incoming voters
+    ref_voters_out: jnp.ndarray  # [M, C] bool reference outgoing voters
+    ref_tracked: jnp.ndarray     # [M, C] bool reference tracked members
+    ref_applied: jnp.ndarray     # [C] i32 config epoch (applied index)
     metrics: CrashMetrics
 
 
 def empty_crash_state(state: NodeState) -> CrashState:
-    return CrashState(
+    f2 = jnp.zeros_like(state.last_index, dtype=jnp.bool_)
+    base = CrashState(
         stable=state.last_index,
         down=jnp.zeros_like(state.last_index),
         watermark=state.commit.max(axis=0),
         prev_term=state.term,
+        ref_voters=f2, ref_voters_out=f2, ref_tracked=f2,
+        # epoch -1: the first refresh always adopts the boot config
+        ref_applied=jnp.full(state.term.shape[-1:], -1, jnp.int32),
         metrics=zero_crash_metrics(),
     )
+    return refresh_ref_config(state, base)
 
 
 def _bc(spec: Spec, mask, leaf):
@@ -312,12 +514,14 @@ def build_chaos_epoch(
     tick: bool = True,
     with_delay: bool = True,
     with_crash: bool = False,
+    with_member: bool = False,
 ):
     """One jitted chaos epoch: `rounds` lockstep rounds of faulted traffic
     with per-round invariant checks.
 
     Returns fn(state, inbox, held, crash, key, prop_len, prop_data, viol,
-    drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log) ->
+    drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log,
+    config_aware, member_p, palette, snap_boost, member_boost) ->
     (state, inbox, held, crash, key, viol, commits_delta). The fault
     probabilities are RUNTIME operands, not closure constants — one
     traced program serves every fault mix (a full trace costs ~40s of
@@ -326,7 +530,10 @@ def build_chaos_epoch(
     (per-node per-round kill probability), ``down_rounds`` (outage
     length) and ``keep_log`` (False = the broken persist-nothing
     durability model) are operands, so the honest and deliberately-broken
-    models share one trace. The regression
+    models share one trace — as do ``config_aware`` (False = the broken
+    config-blind checker variant), the membership palette/rate and the
+    targeting boosts, so one trace serves every membership mix and
+    targeting intensity too. The regression
     baseline (prev_commit) starts at the entry state's own commit —
     nothing moves between epochs, so passing it across the boundary
     would merely alias a leaf of the donated state.
@@ -335,7 +542,8 @@ def build_chaos_epoch(
     partitioned with probability partition_p into two random sides (links
     across sides drop entirely); other faults stack on top. `faultless`
     selects the structurally-reduced heal program (no sampling, no held
-    bookkeeping), which ignores the probability operands.
+    bookkeeping, no membership injection), which ignores the probability
+    operands.
 
     `with_delay=False` removes the delay/reorder machinery AT TRACE TIME:
     no Bernoulli delay draws, no held-buffer merge, and no held pytree
@@ -346,21 +554,33 @@ def build_chaos_epoch(
     at 524k groups. Callers pass held=None and get None back.
 
     `with_crash=False` removes the crash–restart machinery AT TRACE TIME
-    the same way (no crash sampling, no CrashState in the carry, no
-    recovery checkers — the legacy network-fault programs are
-    structurally unchanged). Callers pass crash=None and get None back.
-    With it on, the heal program still runs down-timers to completion
-    and keeps checking the recovery invariants; only fault epochs sample
-    new crashes.
+    the same way (no crash sampling, no targeted scheduler). Callers pass
+    crash=None and get None back — UNLESS `with_member` is on, which
+    keeps the CrashState carry (reference config, watermark, metrics)
+    and the recovery checkers alive without any crash sampling; the
+    legacy network-fault programs (both flags off) are structurally
+    unchanged. With crashes on, the heal program still runs down-timers
+    to completion and keeps checking the recovery invariants; only fault
+    epochs sample new crashes.
+
+    `with_member` adds the membership-change fault class to fault epochs:
+    node 0's per-round proposal becomes an encoded conf-change word with
+    probability ``member_p``, sampled from the i32[P] ``palette`` operand
+    (member_palette), with guard-outcome / applied-transition counters
+    accumulated in CrashMetrics. Fault epochs with crashes also route the
+    crash budget through targeted_crash_probs over the snapshot-install
+    and membership-sensitive windows (boosts of 1 = plain Bernoulli).
     """
     round_fn = build_round(cfg, spec)
     M = spec.M
-    # static majority of the full member set — crash chaos runs fixed
-    # all-voter fleets (see check_recovery_invariants)
-    quorum = M // 2 + 1
+    # recovery bookkeeping (CrashState carry + config-aware checkers) is
+    # needed by either fault class: crashes lose state, membership
+    # changes move the quorum the checkers must count against
+    with_recovery = with_crash or with_member
 
     def epoch(state, inbox, held, crash, key, prop_len, prop_data, viol,
-              drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log):
+              drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log,
+              config_aware, member_p, palette, snap_boost, member_boost):
         prev_commit = state.commit
         C = state.term.shape[-1]
         zp = jnp.zeros((M, spec.E, C), jnp.int32)
@@ -375,7 +595,8 @@ def build_chaos_epoch(
             kill fresh nodes (volatile-state wipe to the durable floor),
             silence all down hosts' in-flight traffic, refresh the floor.
             Returns (..., crashed_now, alive); no-op when crashes are
-            compiled out."""
+            compiled out (a member-only program passes its CrashState
+            carry through untouched — only post_checks updates it)."""
             if not with_crash:
                 return state, inbox, held, crash, key, None, None
             was_down = crash.down > 0
@@ -383,7 +604,17 @@ def build_chaos_epoch(
             restarted = (was_down & (down == 0)).sum().astype(jnp.int32)
             if sample:
                 key, ck, tk = jax.random.split(key, 3)
-                hit = jax.random.bernoulli(ck, crash_p, (M, C)) & (down == 0)
+                # targeted scheduling: concentrate the SAME expected
+                # crash budget on the snapshot-install and membership-
+                # sensitive windows (boosts of 1 reproduce the uniform
+                # Bernoulli schedule); windows/crashes are counted at
+                # sampling instants only, so heal rounds don't dilute
+                # the hit-rate comparison
+                snap_win = snapshot_window_mask(spec, state, inbox)
+                mem_win = member_window_mask(spec, state)
+                p_lane = targeted_crash_probs(
+                    crash_p, snap_win, mem_win, snap_boost, member_boost)
+                hit = jax.random.bernoulli(ck, p_lane) & (down == 0)
                 # restart draws a fresh randomized election timeout in
                 # [T, 2T), same distribution as boot (models/state.py)
                 rand_to = cfg.election_tick + jax.random.randint(
@@ -392,6 +623,17 @@ def build_chaos_epoch(
                     spec, state, hit, crash.stable, rand_to,
                     keep_log=keep_log)
                 down = jnp.where(hit, down_rounds, down)
+                mw = crash.metrics
+                crash = crash.replace(metrics=mw.replace(
+                    snap_window_lanes=mw.snap_window_lanes
+                    + snap_win.sum().astype(jnp.int32),
+                    snap_window_crashes=mw.snap_window_crashes
+                    + (hit & snap_win).sum().astype(jnp.int32),
+                    member_window_lanes=mw.member_window_lanes
+                    + mem_win.sum().astype(jnp.int32),
+                    member_window_crashes=mw.member_window_crashes
+                    + (hit & mem_win).sum().astype(jnp.int32),
+                ))
             else:
                 hit = jnp.zeros((M, C), jnp.bool_)
                 lost = jnp.int32(0)
@@ -427,13 +669,70 @@ def build_chaos_epoch(
             return (keep & alive[:, None, :] & alive[None, :, :],
                     jnp.where(alive, pl, 0), dt & alive)
 
-        def post_checks(state, prev_commit, crash, viol, hit):
+        def inject_member(state, crash, key, alive):
+            """Swap node 0's proposal payload for an encoded conf-change
+            word with probability member_p per (round, group), sampled
+            from the palette operand, and record the leader-side guard
+            outcome (stepLeader refuses a cc while one is pending in
+            (applied, pci] or the config is already joint) against the
+            group's CURRENT leader — exact when node 0 leads, a one-round
+            -skewed estimate when the proposal forwards. A draw landing
+            while node 0 is down is discarded BEFORE the counters:
+            mask_down zeroes its prop_len, so nothing enters the system
+            and counting it would overstate injected proposals."""
+            key, kc, kw = jax.random.split(key, 3)
+            do_cc = jax.random.bernoulli(kc, member_p, (C,))
+            if alive is not None:
+                do_cc = do_cc & alive[0]
+            P = palette.shape[0]
+            pi = jax.random.randint(kw, (C,), 0, P, dtype=jnp.int32)
+            sel = pi[None, :] == jnp.arange(P, dtype=jnp.int32)[:, None]
+            word = (sel * palette[:, None]).sum(axis=0).astype(jnp.int32)
+            pd = prop_data.at[0, 0].set(
+                jnp.where(do_cc, word, prop_data[0, 0]))
+            pt = zp.at[0, 0].set(
+                jnp.where(do_cc, ENTRY_CONF_CHANGE, 0))
+            is_lead = state.role == ROLE_LEADER                     # [M, C]
+            guard = (state.pending_conf_index > state.applied) \
+                | state.voters_out.any(axis=1)
+            has_lead = is_lead.any(axis=0)
+            refuse = (is_lead & guard).any(axis=0)
+            m = crash.metrics
+            crash = crash.replace(metrics=m.replace(
+                member_changes_proposed=m.member_changes_proposed
+                + do_cc.sum().astype(jnp.int32),
+                cc_guard_refusals=m.cc_guard_refusals
+                + (do_cc & has_lead & refuse).sum().astype(jnp.int32),
+                cc_guard_admits=m.cc_guard_admits
+                + (do_cc & has_lead & ~refuse).sum().astype(jnp.int32),
+            ))
+            return key, pd, pt, crash
+
+        def post_checks(pre, state, prev_commit, crash, viol, hit):
+            """Per-round checkers + applied-config transition counting.
+            ``pre`` is the state AFTER pre_round (so crash rewinds never
+            count as transitions) and BEFORE the round step."""
             viol = check_invariants(state, prev_commit, viol, exempt=hit)
-            if with_crash:
-                viol, wm = check_recovery_invariants(
-                    spec, state, crash.watermark, crash.prev_term, viol,
-                    quorum)
-                crash = crash.replace(watermark=wm, prev_term=state.term)
+            if with_recovery:
+                ch = (
+                    (pre.voters != state.voters)
+                    | (pre.voters_out != state.voters_out)
+                    | (pre.learners != state.learners)
+                    | (pre.learners_next != state.learners_next)
+                ).any(axis=1)                                       # [M, C]
+                was_j = pre.voters_out.any(axis=1)
+                now_j = state.voters_out.any(axis=1)
+                m = crash.metrics
+                crash = crash.replace(metrics=m.replace(
+                    conf_changes_applied=m.conf_changes_applied
+                    + ch.sum().astype(jnp.int32),
+                    joint_entered=m.joint_entered
+                    + (~was_j & now_j).sum().astype(jnp.int32),
+                    joint_left=m.joint_left
+                    + (was_j & ~now_j).sum().astype(jnp.int32),
+                ))
+                viol, crash = check_recovery_invariants(
+                    spec, state, crash, viol, config_aware)
             return crash, viol
 
         if faultless:
@@ -453,12 +752,13 @@ def build_chaos_epoch(
                 state, inbox, crash, viol, prev_commit = carry
                 state, inbox, _, crash, _, hit, alive = pre_round(
                     state, inbox, None, crash, None, False)
+                pre = state
                 keep, pl, dt = mask_down(keep_all, prop_len, do_tick, alive)
                 state, out = round_fn(
                     state, inbox, pl, prop_data, zp, z2, no, dt, keep
                 )
-                crash, viol = post_checks(state, prev_commit, crash, viol,
-                                          hit)
+                crash, viol = post_checks(pre, state, prev_commit, crash,
+                                          viol, hit)
                 return (state, out, crash, viol, state.commit), None
 
             (state, inbox, crash, viol, prev_commit), _ = jax.lax.scan(
@@ -489,17 +789,23 @@ def build_chaos_epoch(
                 state, inbox, held, crash, key, viol, prev_commit = carry
                 state, inbox, held, crash, key, hit, alive = pre_round(
                     state, inbox, held, crash, key, True)
+                pre = state
+                if with_member:
+                    key, pd, pt, crash = inject_member(state, crash, key,
+                                                       alive)
+                else:
+                    pd, pt = prop_data, zp
                 key, kl, keep = sample_keep(key, r)
                 keep, pl, dt = mask_down(keep, prop_len, do_tick, alive)
                 state, out = round_fn(
-                    state, inbox, pl, prop_data, zp, z2, no, dt, keep
+                    state, inbox, pl, pd, pt, z2, no, dt, keep
                 )
                 delay = jax.random.bernoulli(
                     kl, delay_p, (M, spec.K * M, C)
                 ) & (out.type != 0)
                 nxt, held2 = _merge_delayed(spec, out, held, delay)
-                crash, viol = post_checks(state, prev_commit, crash, viol,
-                                          hit)
+                crash, viol = post_checks(pre, state, prev_commit, crash,
+                                          viol, hit)
                 return (state, nxt, held2, crash, key, viol,
                         state.commit), None
 
@@ -514,13 +820,19 @@ def build_chaos_epoch(
                 state, inbox, crash, key, viol, prev_commit = carry
                 state, inbox, _, crash, key, hit, alive = pre_round(
                     state, inbox, None, crash, key, True)
+                pre = state
+                if with_member:
+                    key, pd, pt, crash = inject_member(state, crash, key,
+                                                       alive)
+                else:
+                    pd, pt = prop_data, zp
                 key, _, keep = sample_keep(key, r)
                 keep, pl, dt = mask_down(keep, prop_len, do_tick, alive)
                 state, out = round_fn(
-                    state, inbox, pl, prop_data, zp, z2, no, dt, keep
+                    state, inbox, pl, pd, pt, z2, no, dt, keep
                 )
-                crash, viol = post_checks(state, prev_commit, crash, viol,
-                                          hit)
+                crash, viol = post_checks(pre, state, prev_commit, crash,
+                                          viol, hit)
                 return (state, out, crash, key, viol, state.commit), None
 
             (state, inbox, crash, key, viol, prev_commit), _ = jax.lax.scan(
@@ -536,7 +848,7 @@ def build_chaos_epoch(
 @functools.lru_cache(maxsize=32)
 def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
                    faultless: bool, with_delay: bool = True,
-                   with_crash: bool = False):
+                   with_crash: bool = False, with_member: bool = False):
     """One jitted epoch program per (cfg, spec, rounds, structure),
     shared across every run_chaos call and fault mix (probabilities are
     operands). Donation of the fleet-sized carries (state/inbox/held) is
@@ -553,7 +865,8 @@ def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
         donate = ()
     return jax.jit(
         build_chaos_epoch(cfg, spec, rounds, faultless=faultless,
-                          with_delay=with_delay, with_crash=with_crash),
+                          with_delay=with_delay, with_crash=with_crash,
+                          with_member=with_member),
         donate_argnums=donate,
     )
 
@@ -571,6 +884,9 @@ def run_chaos(
     partition_p: float = 0.1,
     crash_p: float = 0.0,
     crash: CrashConfig | None = None,
+    member_p: float = 0.0,
+    member: MemberChaosConfig | None = None,
+    config_aware: bool = True,
     propose: bool = True,
     sync_dispatch: bool = False,
 ) -> dict:
@@ -583,23 +899,60 @@ def run_chaos(
     probability during fault epochs) with the durability model described
     by ``crash`` (default CrashConfig: 3-round outages, fsync-lag entry
     loss); crash_p=0 compiles the whole crash machinery out.
+
+    ``member_p`` > 0 enables membership-change faults: node 0's proposal
+    becomes an encoded conf-change word with this probability per
+    (round, group) during fault epochs, drawn from the palette named by
+    ``member.mix`` (member_palette); ``member.initial_voters`` boots each
+    group with a partial voter set so adds have room. The crash boosts in
+    ``member`` route the crash budget through the targeted scheduler
+    (snapshot-install / membership windows). ``config_aware=False``
+    selects the deliberately-broken config-blind recovery checkers (a
+    runtime operand — it shares the traced programs with the honest
+    mode, like the persist-nothing durability knob).
     """
     with_crash = crash_p > 0
-    if with_crash and spec.M < 2:
+    with_member = member_p > 0
+    if (with_crash or with_member) and spec.M < 2:
         # a singleton commits its own append in the same round, before
         # the modeled fsync completes — the one shape where losing the
         # unsynced suffix can erase a committed entry without any
         # observable ack to wipe
         raise ValueError("crash faults require M >= 2 (fsync-lag model)")
+    if with_member and not propose:
+        # membership faults ride node 0's proposal stream; without it
+        # the injection would only ever increment counters
+        raise ValueError("membership chaos requires propose=True")
+    if with_member and cfg.wire_int16:
+        # conf-change words use bits 16-20 (confchange.py layout) and
+        # ride MsgProp/MsgApp ent_data across the wire — the int16 wire
+        # silently truncates them (the 81d0b1e bug class, this time by
+        # construction rather than by accident)
+        raise ValueError(
+            "membership chaos words exceed the int16 wire (conf-change "
+            "bits 16-20); run with wire_int16=False")
     crash_cfg = (crash or CrashConfig()) if with_crash else None
-    state = init_fleet(spec, C, election_tick=cfg.election_tick, seed=seed)
+    # the member config also carries the crash-boost knobs, which apply
+    # to pure crash runs (snapshot-window targeting needs no membership
+    # faults); the palette/injection side is gated on member_p > 0
+    member_cfg = member or MemberChaosConfig()
+    iv = member_cfg.initial_voters
+    if iv > spec.M:
+        # would silently collapse to the all-voters boot, leaving the
+        # add-voter/add-learner palette words no free slots
+        raise ValueError(
+            f"initial_voters={iv} exceeds the member count M={spec.M}")
+    voters = None if iv == 0 else jnp.arange(spec.M, dtype=jnp.int32) < iv
+    state = init_fleet(spec, C, voters=voters,
+                       election_tick=cfg.election_tick, seed=seed)
     inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
     # delay/reorder faults carry a SPARSE held buffer (HELD_SLOTS packed
     # messages per sender row — see HeldSparse); delay_p=0 still drops
     # the whole machinery at trace time
     with_delay = delay_p > 0
+    with_recovery = with_crash or with_member
     held = empty_held(spec, C, cfg.wire_int16) if with_delay else None
-    crash_state = empty_crash_state(state) if with_crash else None
+    crash_state = empty_crash_state(state) if with_recovery else None
     key = jax.random.PRNGKey(seed)
     M = spec.M
     prop_len = jnp.zeros((M, C), jnp.int32)
@@ -612,14 +965,21 @@ def run_chaos(
         prop_data = prop_data.at[0, 0].set(7)
 
     chaos = _epoch_program(cfg, spec, epoch_len, False, with_delay,
-                           with_crash)
-    heal = _epoch_program(cfg, spec, heal_len, True, with_delay, with_crash)
+                           with_crash, with_member)
+    heal = _epoch_program(cfg, spec, heal_len, True, with_delay, with_crash,
+                          with_member)
     dp = jnp.float32(drop_p)
     lp = jnp.float32(delay_p)
     pp = jnp.float32(partition_p)
     cp = jnp.float32(crash_p)
     dr = jnp.int32(crash_cfg.down_rounds if with_crash else 1)
     kl = jnp.bool_(crash_cfg.durability == "stable" if with_crash else True)
+    ca = jnp.bool_(config_aware)
+    mp = jnp.float32(member_p)
+    palette = (member_palette(spec, member_cfg.mix) if with_member
+               else jnp.zeros((1,), jnp.int32))
+    sb = jnp.float32(member_cfg.snap_crash_boost)
+    mb = jnp.float32(member_cfg.member_crash_boost)
     z = jnp.float32(0.0)
 
     def _sync(x):
@@ -633,16 +993,18 @@ def run_chaos(
     viol = zero_violations()
     commits = []
     done = 0
+    fault_rounds = 0
     while done < rounds:
         state, inbox, held, crash_state, key, viol, dc = chaos(
             state, inbox, held, crash_state, key, prop_len, prop_data, viol,
-            dp, lp, pp, cp, dr, kl
+            dp, lp, pp, cp, dr, kl, ca, mp, palette, sb, mb
         )
         _sync(viol.multi_leader)
         done += epoch_len
+        fault_rounds += epoch_len
         state, inbox, held, crash_state, key, viol, dh = heal(
             state, inbox, held, crash_state, key, prop_len, prop_data, viol,
-            z, z, z, z, dr, kl
+            z, z, z, z, dr, kl, ca, z, palette, sb, mb
         )
         _sync(viol.multi_leader)
         done += heal_len
@@ -660,7 +1022,7 @@ def run_chaos(
             break
         state, inbox, held, crash_state, key, viol, dh = heal(
             state, inbox, held, crash_state, key, prop_len, prop_data, viol,
-            z, z, z, z, dr, kl
+            z, z, z, z, dr, kl, ca, z, palette, sb, mb
         )
         done += heal_len
         commits.append((0, int(dh)))
@@ -683,7 +1045,24 @@ def run_chaos(
         rep["crash_p"] = crash_p
         rep["crash_down_rounds"] = crash_cfg.down_rounds
         rep["crash_durability"] = crash_cfg.durability
+        rep["snap_crash_boost"] = member_cfg.snap_crash_boost
+        rep["member_crash_boost"] = member_cfg.member_crash_boost
+    if with_member:
+        rep["member_p"] = member_p
+        rep["member_mix"] = member_cfg.mix
+        rep["initial_voters"] = member_cfg.initial_voters
+    if with_recovery:
+        rep["config_aware"] = config_aware
         rep.update(crash_metrics_report(crash_state.metrics))
+        if with_crash:
+            # the uniform-Bernoulli window-hit baseline for the targeting
+            # acceptance: the fraction of crash-sampled lanes that were
+            # in-window (windows are counted at sampling instants only)
+            sampled = M * C * fault_rounds
+            rep["snap_window_lane_frac"] = round(
+                rep["snap_window_lanes"] / max(sampled, 1), 6)
+            rep["member_window_lane_frac"] = round(
+                rep["member_window_lanes"] / max(sampled, 1), 6)
     return rep
 
 

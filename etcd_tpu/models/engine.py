@@ -28,10 +28,14 @@ from etcd_tpu.models.state import NodeState, init_node
 from etcd_tpu.ops.outbox import Outbox
 from etcd_tpu.types import (
     ENT_FIELDS,
+    ENTRY_CONF_CHANGE,
+    MSG_SNAP,
     Msg,
     NONE_ID,
     PR_PROBE,
+    PR_SNAPSHOT,
     ROLE_FOLLOWER,
+    ROLE_LEADER,
     Spec,
 )
 from etcd_tpu.utils.config import RaftConfig
@@ -306,6 +310,47 @@ def wipe_crashed_traffic(spec: Spec, inbox: Msg, crashed: jnp.ndarray) -> Msg:
     kill = crashed[:, None, None, :] | crashed[None, None, :, :]
     t5 = jnp.where(kill, 0, t5)
     return inbox.replace(type=t5.reshape(M, K * M, C).astype(inbox.type.dtype))
+
+
+def snapshot_window_mask(spec: Spec, state: NodeState,
+                         inbox: Msg) -> jnp.ndarray:
+    """[M, C] bool: lanes inside the snapshot-install window this round —
+    a MsgSnap is in flight TO the node (the follower is about to install),
+    or the node is a leader with a peer in PR_SNAPSHOT (snapshot sent, ack
+    not yet processed — which also covers the follower's installed-but-
+    unacked round, since the leader stays PR_SNAPSHOT until the MsgAppResp
+    lands). The chaos tier's targeted crash scheduler concentrates crash
+    probability here instead of waiting for Bernoulli luck to land a kill
+    in the (rare) window; ``inbox`` is the FLAT storage form
+    ([from, K*to, C] type leaf, int16 or int32 wire)."""
+    M, K = spec.M, spec.K
+    C = inbox.type.shape[-1]
+    t5 = inbox.type.reshape(M, K, M, C)                 # [from, K, to, C]
+    snap_to = (t5 == MSG_SNAP).any(axis=(0, 1))         # [to, C]
+    snap_from = (state.role == ROLE_LEADER) & (
+        state.pr_state == PR_SNAPSHOT).any(axis=1)      # [M, C]
+    return snap_to | snap_from
+
+
+def member_window_mask(spec: Spec, state: NodeState) -> jnp.ndarray:
+    """[M, C] bool: membership-sensitive lanes — the node's applied config
+    is joint, or a committed-but-unapplied conf-change entry sits in its
+    (applied, commit] window (the batched form of ops/log.py
+    count_pending_conf). These are the regimes where reconfiguration bugs
+    live — a leaving leader stepping down, a change committed under one
+    quorum rule but not yet switched — so the chaos tier's targeted crash
+    scheduler can concentrate kills on them."""
+    L = spec.L
+    li = state.last_index[:, None, :]                   # [M, 1, C]
+    idxs = jnp.arange(L, dtype=jnp.int32)[None, :, None]
+    ent_idx = li - (((li - 1) % L) - idxs) % L          # index living at slot
+    pend_cc = (
+        (ent_idx > state.applied[:, None, :])
+        & (ent_idx <= state.commit[:, None, :])
+        & (ent_idx > state.snap_index[:, None, :])
+        & (state.log_type == ENTRY_CONF_CHANGE)
+    ).any(axis=1)                                       # [M, C]
+    return state.voters_out.any(axis=1) | pend_cc
 
 
 def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):

@@ -68,32 +68,73 @@ def zero_metrics() -> FleetMetrics:
 
 
 class CrashMetrics(struct.PyTreeNode):
-    """Device-resident crash/restart event counters for the chaos tier's
-    crash–restart fault class (harness/chaos.py). Kept separate from
+    """Device-resident crash/restart + membership-chaos event counters for
+    the chaos tier (harness/chaos.py). Kept separate from
     :class:`FleetMetrics` because they ride the chaos epoch's scan carry,
     not the metered round: the chaos program accumulates them as the same
     kind of fused i32 reductions as its Violations counters and the host
-    reads them once per report."""
+    reads them once per report.
+
+    The ``cc_guard_*`` counters record the leader-side proposal-guard
+    outcome (stepLeader refuses a conf change while one is pending or the
+    config is joint) evaluated against the group's CURRENT leader at
+    injection time; when node 0 is not the leader the proposal forwards
+    and the real guard runs a round later, so these are exact for
+    leader-direct proposals and one-round-skewed estimates otherwise.
+    ``conf_changes_applied`` counts (node, round) lanes whose applied
+    config masks changed inside the round step — conf-change applies plus
+    snapshot-install config adoptions, never crash rewinds (the wipe
+    happens before the round and is excluded by construction).
+
+    The ``*_window_*`` counters feed the targeted-crash-scheduler
+    acceptance math: ``snap_window_crashes / crashes_injected`` is the
+    snapshot-window hit rate, compared at equal crash budget against a
+    Bernoulli run (both counted at crash-sampling instants only, so heal
+    rounds don't dilute the rates)."""
 
     crashes_injected: jnp.ndarray     # nodes killed by the crash mask
     entries_lost_fsync: jnp.ndarray   # log entries dropped past `stable`
     restarts_completed: jnp.ndarray   # down-timers that reached 0
+    # membership-change chaos (ISSUE 5)
+    member_changes_proposed: jnp.ndarray  # conf-change proposals injected
+    cc_guard_refusals: jnp.ndarray    # guard outcome at injection: refuse
+    cc_guard_admits: jnp.ndarray      # guard outcome at injection: admit
+    conf_changes_applied: jnp.ndarray # lanes whose applied config changed
+    joint_entered: jnp.ndarray        # lanes entering a joint config
+    joint_left: jnp.ndarray           # lanes leaving a joint config
+    # targeted crash scheduling (snapshot-install / membership windows)
+    snap_window_lanes: jnp.ndarray    # lanes in-window at sampling time
+    snap_window_crashes: jnp.ndarray  # crashes that landed in-window
+    member_window_lanes: jnp.ndarray
+    member_window_crashes: jnp.ndarray
 
 
 def zero_crash_metrics() -> CrashMetrics:
     z = jnp.int32(0)
-    return CrashMetrics(crashes_injected=z, entries_lost_fsync=z,
-                        restarts_completed=z)
+    return CrashMetrics(
+        crashes_injected=z, entries_lost_fsync=z, restarts_completed=z,
+        member_changes_proposed=z, cc_guard_refusals=z, cc_guard_admits=z,
+        conf_changes_applied=z, joint_entered=z, joint_left=z,
+        snap_window_lanes=z, snap_window_crashes=z,
+        member_window_lanes=z, member_window_crashes=z,
+    )
 
 
 def crash_metrics_report(m: CrashMetrics) -> dict:
-    """One host transfer -> plain-dict counters for the chaos report JSON."""
+    """One host transfer -> plain-dict counters for the chaos report JSON,
+    plus the derived window-hit rates the targeting acceptance compares."""
     m = jax.device_get(m)
-    return {
-        "crashes_injected": int(m.crashes_injected),
-        "entries_lost_fsync": int(m.entries_lost_fsync),
-        "restarts_completed": int(m.restarts_completed),
-    }
+    out = {k: int(getattr(m, k)) for k in CrashMetrics.__dataclass_fields__}
+    if any(v < 0 for v in out.values()):
+        raise OverflowError(
+            "CrashMetrics counter wrapped (i32); shorten the run or shard "
+            "the report window"
+        )
+    crashes = max(out["crashes_injected"], 1)
+    out["snap_window_hit_rate"] = round(out["snap_window_crashes"] / crashes, 6)
+    out["member_window_hit_rate"] = round(
+        out["member_window_crashes"] / crashes, 6)
+    return out
 
 
 def build_metered_round(cfg: RaftConfig, spec: Spec):

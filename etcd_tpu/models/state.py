@@ -145,7 +145,12 @@ class NodeState(struct.PyTreeNode):
 #    applied/applied_hash rewind to the snapshot cursor (the fused apply
 #    loop then re-applies committed entries, reproducing the identical
 #    hash chain — which the KV_HASH checker verifies), and the applied
-#    config masks rewind to the snapshot's ConfState.
+#    config masks rewind to the snapshot's ConfState. The chaos tier's
+#    config-aware recovery checkers key on this: a crash may regress a
+#    node's applied config VIEW, but never the durable conf entries, so
+#    the checkers carry the newest-ever applied config across outages
+#    (harness/chaos.py refresh_ref_config) instead of re-reading the
+#    possibly-rewound masks.
 #  * VOLATILE: reset to fresh-follower boot values (raft.go:318-370
 #    newRaft on restart): role/lead/timers/tracker/votes/queues. The
 #    randomized election timeout is re-drawn; rng_key is carried through

@@ -227,3 +227,58 @@ class CrashConfig:
             raise ValueError(
                 f"unknown durability {self.durability!r}; "
                 "known: ['none', 'stable']")
+
+
+# fault-mix names the membership tier's palette builder understands
+# (harness/chaos.py member_palette); chaos_run.py validates CHAOS_MEMBER_MIX
+# against this tuple before any device work.
+MEMBER_MIXES = ("standard", "simple", "shrink")
+
+
+@dataclasses.dataclass(frozen=True)
+class MemberChaosConfig:
+    """Membership-change fault model for the chaos tier (harness/chaos.py).
+
+    Like the crash knobs, everything here that shapes behavior at runtime
+    rides as RUNTIME operands of the epoch program: the conf-change word
+    palette is an i32[P] operand sampled per (round, group), and the two
+    crash-boost factors are f32 operands of the targeted crash scheduler —
+    one traced program serves every membership mix and every targeting
+    intensity; only member_p > 0 vs == 0 changes program structure.
+
+    ``initial_voters`` boots each group with members 0..initial_voters-1
+    as voters and the rest outside the config, so add-voter/add-learner
+    words have free slots to grow into (0 = all M members start as
+    voters, the legacy crash-tier shape). The palette never removes or
+    demotes members 0 and 1: the fsync-lag crash model needs >= 2 voters
+    (run_chaos's M >= 2 guard), and an unconstrained remove schedule
+    could legally drain the voter set to a singleton — or to empty, which
+    the host-side Changer forbids but the device path applies
+    unconditionally.
+
+    The crash boosts concentrate the SAME expected crash budget
+    (crash_p * lanes) on fault windows instead of spreading it Bernoulli-
+    uniformly: ``snap_crash_boost`` multiplies the per-lane crash
+    probability inside the snapshot-install window (MsgSnap in flight to
+    the node, or a leader with a peer in PR_SNAPSHOT between send and
+    ack), ``member_crash_boost`` inside the membership-sensitive window
+    (joint config, or a committed-but-unapplied conf change). 1.0 = no
+    targeting (pure Bernoulli, the PR-1 behavior).
+    """
+
+    mix: str = "standard"          # palette name, one of MEMBER_MIXES
+    initial_voters: int = 0        # 0 = all M members boot as voters
+    snap_crash_boost: float = 1.0
+    member_crash_boost: float = 1.0
+
+    def __post_init__(self):
+        if self.mix not in MEMBER_MIXES:
+            raise ValueError(
+                f"unknown member mix {self.mix!r}; known: "
+                f"{sorted(MEMBER_MIXES)}")
+        if self.initial_voters == 1 or self.initial_voters < 0:
+            # a singleton commits its own append before the modeled fsync
+            # completes — the shape the crash tier already rejects
+            raise ValueError("initial_voters must be 0 (= all) or >= 2")
+        if self.snap_crash_boost < 1.0 or self.member_crash_boost < 1.0:
+            raise ValueError("crash boosts must be >= 1.0 (1.0 = uniform)")

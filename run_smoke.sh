@@ -10,7 +10,10 @@
 # chaos tiers, tools and e2e only run there. The crash-chaos tier's
 # fast configuration (tests/test_recovery_crash.py: <=64 groups, <=2 fault
 # epochs; the 262k variant stays behind -m slow) runs HERE because
-# crash recovery exercises the raft state machines this tier guards.
+# crash recovery exercises the raft state machines this tier guards —
+# as does the membership-chaos tier's fast configuration
+# (tests/test_recovery_member.py: <=16 groups, conf-change injection +
+# config-aware checkers; the 4096-group shape stays behind -m slow).
 cd "$(dirname "$0")"
 exec python -m pytest -q -m 'not slow' \
   tests/test_datadriven_quorum.py \
@@ -27,4 +30,5 @@ exec python -m pytest -q -m 'not slow' \
   tests/test_apply_specialization.py \
   tests/test_sparse_held.py \
   tests/test_recovery_crash.py \
+  tests/test_recovery_member.py \
   "$@"

@@ -92,6 +92,7 @@ def test_jwt_provider_requires_key_and_known_method():
 # (auth/jwt.go:152-156 + options.go:88-103: RSA / RSA-PSS / ECDSA)
 
 def _rsa_pem() -> bytes:
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
 
@@ -102,6 +103,7 @@ def _rsa_pem() -> bytes:
 
 
 def _ec_pem(curve=None) -> bytes:
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import ec
 
@@ -112,6 +114,7 @@ def _ec_pem(curve=None) -> bytes:
 
 
 def _pub_of(pem: bytes) -> bytes:
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import serialization
 
     k = serialization.load_pem_private_key(pem, password=None)
@@ -121,12 +124,14 @@ def _pub_of(pem: bytes) -> bytes:
 
 
 def _ec384_pem() -> bytes:
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.asymmetric import ec
 
     return _ec_pem(ec.SECP384R1())
 
 
 def _ec521_pem() -> bytes:
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.asymmetric import ec
 
     return _ec_pem(ec.SECP521R1())
@@ -169,6 +174,7 @@ def test_jwt_public_key_is_verify_only():
 
 
 def test_jwt_es_curve_mismatch_rejected():
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.asymmetric import ec
 
     with pytest.raises(AuthError, match="curve"):

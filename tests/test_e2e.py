@@ -134,6 +134,7 @@ def test_e2e_https_auto_tls(tmp_path):
     """--auto-tls end to end: the spawned process generates its own
     certs; etcdctl connects with --cacert; a client without the CA is
     refused at the handshake."""
+    pytest.importorskip("cryptography")  # auto-TLS cert generation
     data = str(tmp_path / "d")
     port = _free_port()
     proc = _spawn(data, port, "--auto-tls")
@@ -205,6 +206,7 @@ def test_e2e_mtls_cert_cn_auth_survives_restart(tmp_path):
     certs), enable auth and scope a user over the wire, authenticate
     by client-cert CN alone, SIGKILL, restart — the auth state and TLS
     config must survive the data dir round-trip."""
+    pytest.importorskip("cryptography")  # CA + cert issuance
     from etcd_tpu.client import RemoteClient, RemoteError
     from etcd_tpu.transport import TLSInfo, generate_ca, issue_cert
 

@@ -1,0 +1,392 @@
+"""Config-aware chaos tier (ISSUE 5): membership-change faults, the
+joint-quorum recovery checkers, and targeted snapshot-install crash
+scheduling.
+
+The reference's functional tester exercises member add/remove cases
+(tester/case_member_*.go) against a live cluster; here the same fault
+class runs on-device — encoded conf-change words injected into the epoch
+scan — and the crash-recovery checkers count durable holders against the
+group's live (possibly joint) configuration instead of a static
+full-member majority.
+
+The default tests run tiny fleets on CPU (<=16 groups — the
+run_smoke.sh configuration); the 4096-group acceptance shape rides
+behind the `slow` marker and chaos_run.py (CHAOS_MEMBER=0.05
+CHAOS_CRASH=0.01).
+"""
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from etcd_tpu.harness.chaos import (
+    VIOLATION_KEYS,
+    check_recovery_invariants,
+    empty_crash_state,
+    member_palette,
+    run_chaos,
+    summarize_chaos,
+    targeted_crash_probs,
+    zero_violations,
+)
+from etcd_tpu.models.engine import (
+    empty_inbox,
+    init_fleet,
+    member_window_mask,
+    snapshot_window_mask,
+)
+from etcd_tpu.types import (
+    CC_ADD_LEARNER,
+    CC_ADD_NODE,
+    CC_REMOVE_NODE,
+    ENTRY_CONF_CHANGE,
+    MSG_SNAP,
+    PR_SNAPSHOT,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import (
+    CrashConfig,
+    MemberChaosConfig,
+    RaftConfig,
+)
+
+SPEC = Spec(M=5, L=32, E=2, K=4, W=2, R=2, A=4)
+CFG = RaftConfig(pre_vote=True, check_quorum=True)
+# the two run_chaos tests use the lean bench-like geometry: the smoke
+# tier's wall-clock is dominated by tracing the epoch programs, and the
+# serial message-slot count (K*M) is the trace-cost multiplier — K=2/E=1
+# halves it vs SPEC while exercising identical member-chaos structure
+# (SPEC stays for the mask/checker unit tests, which trace nothing big)
+RUN_SPEC = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+
+
+def assert_safe(rep):
+    for k in VIOLATION_KEYS:
+        assert rep[k] == 0, rep
+
+
+# ------------------------------------------------------------ end to end
+
+def test_member_chaos_small_fleet():
+    """Seeded small-fleet run with conf-change proposals stacked on the
+    crash + network mix: all six checkers stay zero, the fleet recovers,
+    and membership actually churned (proposals injected, configs applied,
+    joint configs entered and left — the fault class is live, not
+    vacuously safe)."""
+    rep = run_chaos(
+        RUN_SPEC, CFG, C=16, rounds=50, epoch_len=25, heal_len=25, seed=2,
+        drop_p=0.02, delay_p=0.05, partition_p=0.1,
+        crash_p=0.03, crash=CrashConfig(down_rounds=2),
+        member_p=0.15, member=MemberChaosConfig(initial_voters=3),
+    )
+    assert_safe(rep)
+    assert rep["crashes_injected"] > 0
+    assert rep["member_changes_proposed"] > 0
+    assert rep["conf_changes_applied"] > 0
+    assert rep["joint_entered"] > 0
+    # guard outcomes were recorded for leader-direct proposals
+    assert rep["cc_guard_refusals"] + rep["cc_guard_admits"] > 0
+    # conscious liveness floor (summarize_chaos contract): membership
+    # churn legally starves fault epochs harder than the standard mix —
+    # joint configs need BOTH halves to commit, and partial-voter boots
+    # leave partitioned minorities smaller — so the floor drops from the
+    # standard-mix default 0.2 to 0.1 of fault-free throughput
+    summary = summarize_chaos(rep, rounds=50, epoch_len=25, heal_len=25,
+                              liveness_frac=0.1)
+    assert summary["safe"] and summary["recovered"] and summary["lively"], (
+        rep, summary)
+
+
+def test_config_blind_checker_fires_on_remove_voter():
+    """The deliberately config-blind checker variant (the pre-ISSUE-5
+    static full-member majority) must fire on a remove-voter + crash
+    schedule that the config-aware checker accepts: once a group shrinks
+    to voters {0, 1}, new commits are durably held by 2 members — every
+    quorum of the LIVE config, but fewer than the static M//2+1 bar.
+    Proves the rework is live, the same way persist-nothing proves the
+    leader-completeness checker fires.
+
+    Deliberately the SAME cfg/spec/epoch geometry as the honest test
+    above: config_aware is a runtime operand, so both runs reuse the
+    epoch programs already traced in this session."""
+    kw = dict(
+        C=16, rounds=25, epoch_len=25, heal_len=25, seed=5,
+        drop_p=0.0, delay_p=0.05, partition_p=0.0,
+        crash_p=0.02, crash=CrashConfig(down_rounds=2),
+        member_p=0.25,
+        member=MemberChaosConfig(mix="shrink", initial_voters=3),
+    )
+    honest = run_chaos(RUN_SPEC, CFG, config_aware=True, **kw)
+    assert_safe(honest)
+    assert honest["conf_changes_applied"] > 0
+    blind = run_chaos(RUN_SPEC, CFG, config_aware=False, **kw)
+    assert blind["lost_commit"] > 0, blind
+
+
+# ------------------------------------------------------ palette / knobs
+
+def _decode_deltas(w: int):
+    out = []
+    if w & (1 << 16):
+        out.append((w & 7, (w >> 3) & 31))
+    if w & (1 << 17):
+        out.append(((w >> 8) & 7, (w >> 11) & 31))
+    return out
+
+
+@pytest.mark.parametrize("mix", ["standard", "simple", "shrink"])
+def test_member_palette_never_drains_voter_floor(mix):
+    """No palette word removes or demotes members 0/1 — the >= 2 voter
+    floor the fsync-lag crash model requires (the device applies
+    committed changes unconditionally, so the palette is where the floor
+    is enforced)."""
+    words = np.asarray(member_palette(SPEC, mix))
+    assert words.size > 0
+    for w in words:
+        deltas = _decode_deltas(int(w))
+        assert deltas, hex(int(w))
+        for op, nid in deltas:
+            if op in (CC_REMOVE_NODE, CC_ADD_LEARNER):
+                assert nid >= 2, (mix, hex(int(w)))
+    if mix == "shrink":
+        assert all(op == CC_REMOVE_NODE
+                   for w in words for op, _ in _decode_deltas(int(w)))
+    if mix == "standard":
+        # auto-joint two-delta words present
+        assert any(len(_decode_deltas(int(w))) == 2 for w in words)
+
+
+def test_member_config_validation():
+    with pytest.raises(ValueError, match="unknown member mix"):
+        MemberChaosConfig(mix="nope")
+    with pytest.raises(ValueError, match="initial_voters"):
+        MemberChaosConfig(initial_voters=1)
+    with pytest.raises(ValueError, match="boosts"):
+        MemberChaosConfig(snap_crash_boost=0.5)
+    with pytest.raises(ValueError, match="M >= 3"):
+        member_palette(Spec(M=2, L=8, E=1, K=1, W=2, R=2, A=2))
+    # conf-change words use bits 16-20: the int16 wire would truncate
+    # them silently, so the combination is rejected up front
+    with pytest.raises(ValueError, match="int16 wire"):
+        run_chaos(SPEC, RaftConfig(wire_int16=True), C=4, rounds=10,
+                  member_p=0.1, member=MemberChaosConfig(initial_voters=3))
+
+
+# ------------------------------------------------- targeted scheduling
+
+def test_targeted_crash_probs_preserves_budget():
+    """In-window lanes get boost * crash_p, the leftover budget spreads
+    uniformly, and the round's expected crash count is exactly
+    crash_p * lanes — the equal-budget property the acceptance compares
+    against Bernoulli scheduling."""
+    snap = jnp.zeros((5, 64), jnp.bool_).at[0, :8].set(True)
+    mem = jnp.zeros((5, 64), jnp.bool_).at[1, :16].set(True)
+    p = targeted_crash_probs(jnp.float32(0.01), snap, mem,
+                             jnp.float32(20.0), jnp.float32(5.0))
+    np.testing.assert_allclose(np.asarray(p[0, 0]), 0.2, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(p[1, 0]), 0.05, rtol=1e-5)
+    # budget = 0.01 * 320 = 3.2 expected crashes, preserved exactly
+    np.testing.assert_allclose(float(p.sum()), 3.2, rtol=1e-5)
+    # base lanes share the remainder uniformly
+    np.testing.assert_allclose(
+        np.asarray(p[4, 0]), (3.2 - 8 * 0.2 - 16 * 0.05) / (320 - 24),
+        rtol=1e-5)
+
+    # boosts of 1 reproduce the uniform Bernoulli schedule
+    p1 = targeted_crash_probs(jnp.float32(0.01), snap, mem,
+                              jnp.float32(1.0), jnp.float32(1.0))
+    np.testing.assert_allclose(np.asarray(p1), 0.01, rtol=1e-5)
+
+    # overspending windows scale down rather than exceed the budget
+    p2 = targeted_crash_probs(jnp.float32(0.01), snap, mem,
+                              jnp.float32(1e4), jnp.float32(1e4))
+    np.testing.assert_allclose(float(p2.sum()), 3.2, rtol=1e-4)
+    assert float(p2[4, 0]) == 0.0  # window lanes consumed everything
+
+    # a snapshot-window lane wins over an overlapping member window:
+    # mark the same [0, :8] lanes member-sensitive too
+    both = snap
+    p3 = targeted_crash_probs(jnp.float32(0.01), snap, both,
+                              jnp.float32(30.0), jnp.float32(2.0))
+    np.testing.assert_allclose(np.asarray(p3[0, 0]), 0.3, rtol=1e-5)
+
+
+def test_snapshot_window_mask_detects_both_sides():
+    C = 2
+    state = init_fleet(SPEC, C, seed=0)
+    inbox = empty_inbox(SPEC, C)
+    # MsgSnap in flight from node 0 (slot k=0) to node 2 in group 1
+    t = inbox.type.at[0, 0 * SPEC.M + 2, 1].set(MSG_SNAP)
+    inbox = inbox.replace(type=t)
+    # node 1 leads group 0 with peer 3 in PR_SNAPSHOT (sent, un-acked)
+    state = state.replace(
+        role=state.role.at[1, 0].set(ROLE_LEADER),
+        pr_state=state.pr_state.at[1, 3, 0].set(PR_SNAPSHOT),
+    )
+    win = np.asarray(snapshot_window_mask(SPEC, state, inbox))
+    expect = np.zeros((SPEC.M, C), bool)
+    expect[2, 1] = True   # install-side: MsgSnap addressed to it
+    expect[1, 0] = True   # leader-side: between send and ack
+    np.testing.assert_array_equal(win, expect)
+
+
+def test_member_window_mask_joint_and_pending_cc():
+    C = 2
+    state = init_fleet(SPEC, C, seed=0)
+    # node 2 of group 1 sits in a joint config
+    state = state.replace(
+        voters_out=state.voters_out.at[2, 0, 1].set(True))
+    # node 0 of group 0 has a committed-but-unapplied conf change at
+    # index 3 (slot (3-1) % L): applied 2 < 3 <= commit 4
+    ones = jnp.ones((), jnp.int32)
+    state = state.replace(
+        log_type=state.log_type.at[0, 2, 0].set(ENTRY_CONF_CHANGE),
+        last_index=state.last_index.at[0, 0].set(5),
+        commit=state.commit.at[0, 0].set(4 * ones),
+        applied=state.applied.at[0, 0].set(2 * ones),
+    )
+    win = np.asarray(member_window_mask(SPEC, state))
+    expect = np.zeros((SPEC.M, C), bool)
+    expect[2, 1] = True
+    expect[0, 0] = True
+    np.testing.assert_array_equal(win, expect)
+    # once applied catches up past the cc entry the window closes
+    state2 = state.replace(applied=state.applied.at[0, 0].set(4 * ones))
+    assert not np.asarray(member_window_mask(SPEC, state2))[0, 0]
+
+
+# ------------------------------------------- checker unit semantics
+
+def _fleet_with(voters_mask, C=2, **overrides):
+    state = init_fleet(SPEC, C, voters=jnp.asarray(voters_mask, jnp.bool_),
+                       seed=0)
+    return state.replace(**overrides)
+
+
+def _check(state, config_aware=True):
+    crash = empty_crash_state(state)
+    viol, crash = check_recovery_invariants(
+        SPEC, state, crash, zero_violations(), jnp.bool_(config_aware))
+    return int(viol.lost_commit), int(viol.log_divergence)
+
+
+def _li(per_member, C=2):
+    v = jnp.asarray(per_member, jnp.int32)[:, None]
+    return jnp.broadcast_to(v, (SPEC.M, C))
+
+
+def test_checker_removed_voters_abstain():
+    """Two-voter config, both holding the watermark: every live quorum
+    intersects the holders (safe), while the config-blind static
+    majority (3 of 5 slots) fires — the exact remove-voter regime that
+    blocked membership chaos (ROADMAP)."""
+    state = _fleet_with([True, True, False, False, False],
+                        last_index=_li([5, 5, 0, 0, 0]),
+                        commit=_li([5, 5, 0, 0, 0]))
+    lost, div = _check(state, config_aware=True)
+    assert lost == 0 and div == 0
+    lost_blind, _ = _check(state, config_aware=False)
+    assert lost_blind == 2  # both groups, static majority never held
+
+
+def test_checker_joint_config_needs_both_halves():
+    """Joint consensus protection: a candidate missing the watermark
+    must win BOTH halves. Incoming {0..4} with holders {0,1} is
+    electable-without on its own, but outgoing {0,1,2} still pins the
+    entry (non-holder 2 alone is no quorum) — a config-NAIVE checker
+    evaluating only the incoming half would false-positive here."""
+    vo = jnp.zeros((SPEC.M, SPEC.M, 2), jnp.bool_)
+    vo = vo.at[:, 0].set(True).at[:, 1].set(True).at[:, 2].set(True)
+    state = _fleet_with([True] * 5,
+                        voters_out=vo,
+                        last_index=_li([11, 11, 9, 0, 0]),
+                        commit=_li([11, 11, 9, 0, 0]))
+    lost, _ = _check(state)
+    assert lost == 0
+
+    # drop holder 1: outgoing non-holders {1, 2} now form a quorum of
+    # that half too — the committed index is genuinely erasable
+    state2 = state.replace(last_index=_li([11, 9, 9, 0, 0]))
+    lost2, _ = _check(state2)
+    assert lost2 == 2  # both groups
+
+
+def test_checker_even_half_intersection_bar():
+    """Even-sized halves use the quorum-intersection bar, not majority
+    holdership: 2 holders of 4 voters already intersect every 3-vote
+    quorum (safe); 1 holder leaves a 3-voter non-holder quorum (lost)."""
+    state = _fleet_with([True, True, True, True, False],
+                        last_index=_li([7, 7, 0, 0, 0]),
+                        commit=_li([7, 7, 0, 0, 0]))
+    lost, _ = _check(state)
+    assert lost == 0
+    state2 = state.replace(last_index=_li([7, 0, 0, 0, 0]))
+    lost2, _ = _check(state2)
+    assert lost2 == 2
+
+
+# ---------------------------------------------- chaos_run.py validation
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("env_extra,needle", [
+    ({"CHAOS_CRASH": "1.5"}, "CHAOS_CRASH"),
+    # name validation is delegated to MemberChaosConfig.__post_init__
+    # (single source of truth), so the message names the mix, not the var
+    ({"CHAOS_MEMBER": "0.1", "CHAOS_MEMBER_MIX": "nope"},
+     "unknown member mix"),
+])
+def test_chaos_run_rejects_bad_knobs(env_extra, needle):
+    """Knob validation exits 2 with a pointed message before any device
+    work (no JSON line, no long run)."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **env_extra}
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "chaos_run.py")],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 2, (out.returncode, out.stdout, out.stderr)
+    assert needle in out.stderr
+    assert not out.stdout.strip()
+
+
+# ------------------------------------------------------ acceptance scale
+
+@pytest.mark.slow
+def test_member_chaos_4096_groups_targeted():
+    """The acceptance-scale membership run (bench geometry minus the
+    int16 wire, conf changes + crashes + snapshot-window targeting) —
+    exercised on CPU/TPU via chaos_run.py (CHAOS_C=4096
+    CHAOS_MEMBER=0.05 CHAOS_CRASH=0.005 CHAOS_SNAP_BOOST=200
+    CHAOS_WIRE16=0); here behind the slow marker. The crash budget sits
+    below the window-generation rate so the targeted scheduler's hit
+    rate is window-limited, not budget-limited — the measured operating
+    point for the >= 10x acceptance bar (16.5x at C=64)."""
+    spec = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                     inbox_bound=4, coalesce_commit_refresh=True)
+    kw = dict(
+        C=4096, rounds=200, epoch_len=50, heal_len=25, seed=0,
+        drop_p=0.02, delay_p=0.05, partition_p=0.1,
+        crash_p=0.005, crash=CrashConfig(down_rounds=3), member_p=0.05,
+    )
+    tgt = run_chaos(spec, cfg, member=MemberChaosConfig(
+        initial_voters=3, snap_crash_boost=200.0,
+        member_crash_boost=4.0), **kw)
+    assert_safe(tgt)
+    assert tgt["conf_changes_applied"] > 0
+    # liveness_frac=0.1: the membership mix's conscious floor (see
+    # test_member_chaos_small_fleet)
+    s = summarize_chaos(tgt, rounds=200, epoch_len=50, heal_len=25,
+                        liveness_frac=0.1)
+    assert s["recovered"] and s["lively"], (tgt, s)
+    uni = run_chaos(spec, cfg, member=MemberChaosConfig(
+        initial_voters=3), **kw)
+    assert_safe(uni)
+    # >= 10x the Bernoulli window-hit rate at equal crash budget
+    assert tgt["snap_window_hit_rate"] >= 10 * uni["snap_window_hit_rate"], (
+        tgt["snap_window_hit_rate"], uni["snap_window_hit_rate"])

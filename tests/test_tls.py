@@ -22,6 +22,7 @@ from etcd_tpu.transport import (
 # ------------------------------------------------------- cert generation
 
 def test_self_cert_generates_and_reuses(tmp_path):
+    pytest.importorskip("cryptography")
     d = str(tmp_path / "sc")
     info = self_cert(d, ["127.0.0.1", "localhost"])
     assert os.path.exists(info.cert_file)
@@ -34,6 +35,7 @@ def test_self_cert_generates_and_reuses(tmp_path):
 
 
 def test_ca_issue_cert_cn(tmp_path):
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.x509.oid import NameOID
 
@@ -58,6 +60,7 @@ def test_server_context_requires_keypair():
 
 @pytest.fixture(scope="module")
 def https_etcd(tmp_path_factory):
+    pytest.importorskip("cryptography")  # auto-TLS cert generation
     d = str(tmp_path_factory.mktemp("httpsd"))
     e = start_etcd(Config(cluster_size=1, data_dir=d,
                           client_auto_tls=True, auto_tick=False))
@@ -132,6 +135,7 @@ def test_auto_tls_requires_data_dir():
 def mtls(tmp_path_factory):
     """CA + server/alice/bob certs + an embed server requiring client
     certs, with auth enabled and alice scoped to /app/*."""
+    pytest.importorskip("cryptography")  # CA + cert issuance
     d = str(tmp_path_factory.mktemp("mtls"))
     ca = generate_ca(os.path.join(d, "certs"))
     server = issue_cert(os.path.join(d, "certs"), ca, "server",
@@ -311,6 +315,7 @@ def test_stalled_client_does_not_block_accepts(https_etcd):
 # ------------------------------------------------------ allowed-CN gate
 
 def test_allowed_cn_gate(tmp_path):
+    pytest.importorskip("cryptography")
     d = str(tmp_path)
     ca = generate_ca(os.path.join(d, "certs"))
     server = issue_cert(os.path.join(d, "certs"), ca, "server",
