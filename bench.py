@@ -1,4 +1,4 @@
-"""Headline benchmark: batched consensus throughput.
+"""Headline benchmark: batched consensus throughput (+ served writes).
 
 Measures lockstep consensus rounds/sec over a fleet of C concurrent
 5-member Raft groups, with one proposal injected per group per round
@@ -7,6 +7,35 @@ apply), and reports group-rounds/sec against the north-star target of
 1M groups x 10k rounds/sec on one v5e-8 (BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+APPLY_MODE != off switches to the END-TO-END SERVED-WRITES benchmark
+(evidence: APPLY_r08.json): every round proposes one canonical KV put
+per group, and a write only counts once it is committed, APPLIED to an
+MVCC revision store, and surfaced as a WATCH DELTA —
+
+  * device: the device-resident apply plane (etcd_tpu/device_mvcc)
+    fused into the round program (models/engine.py build_kv_round);
+    the per-round watch-delta event count is the host handoff.
+  * host: the same consensus fleet with device-apply off; each round
+    the committed words cross to the host and replay through one
+    WatchableStore/MVCCStore per group with a full-range watcher —
+    the kvserver._pump plane, per group (what "writes/s" costs today).
+
+APPLY_MODE=both runs device then host on identical proposal schedules
+and cross-checks the canonical latest-record digests on sample lanes
+(the same shared fold the differential fuzz gates on).
+
+Knobs (validated up front; a bad value exits 2 before any device work):
+  APPLY_MODE   off|device|host|both   (default off)
+  APPLY_C      groups                 (default 8192 CPU / 262144 accel)
+  APPLY_ROUNDS timed rounds           (default 32)
+  APPLY_KEYS   device key-space size  (default 64, 1..511)
+
+KV op words need the int32 wire, so the apply benchmark forces
+wire_int16=False (same rule as the membership chaos tier).
+
+TPU rerun (when the accelerator tunnel returns):
+  APPLY_MODE=both APPLY_C=262144 python bench.py > APPLY_TPU_r08.json
 """
 from __future__ import annotations
 
@@ -38,12 +67,186 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
 # (reference README.md:22; BASELINE.md). One group-round = one replicated
 # write for one 5-member group, so vs_baseline > 1 beats the reference.
 BASELINE_WRITES_PER_SEC = 10_000
+
+
+def _apply_knobs() -> dict:
+    """Parse + validate the APPLY_* env knobs (exit 2 before any device
+    work on a bad value — utils/knobs.py, the chaos_run.py pattern)."""
+    from etcd_tpu.utils.knobs import env_int, knob_error
+
+    mode = os.environ.get("APPLY_MODE", "off")
+    if mode not in ("off", "device", "host", "both"):
+        knob_error("bench", f"APPLY_MODE={mode!r} not one of "
+                   "off|device|host|both")
+    out = {"mode": mode}
+    for name, default, lo, hi in (
+        ("APPLY_C", None, 1, None),
+        ("APPLY_ROUNDS", "32", 1, None),
+        ("APPLY_KEYS", "64", 1, 511),  # scheme.MAX_KEYS (9-bit key field)
+    ):
+        out[name] = env_int("bench", name, default, lo, hi)
+    return out
+
+
+def _apply_bench(knobs: dict, platform: str, on_accel: bool) -> None:
+    """The served-writes benchmark (see module docstring)."""
+    import numpy as np
+
+    from etcd_tpu.device_mvcc import KVSpec, init_kv, scheme
+    from etcd_tpu.device_mvcc.apply import kv_digest
+    from etcd_tpu.models.engine import (
+        _jitted_kv_round,
+        empty_inbox,
+        init_fleet,
+    )
+    from etcd_tpu.server.mvcc import MVCCStore
+    from etcd_tpu.server.watch import WatchableStore
+    from etcd_tpu.types import Spec
+    from etcd_tpu.utils.config import RaftConfig
+
+    C = knobs["APPLY_C"] or (262_144 if on_accel else 8192)
+    rounds = knobs["APPLY_ROUNDS"]
+    keys = knobs["APPLY_KEYS"]
+    kvspec = KVSpec(keys=keys)
+    # bench geometry minus the int16 wire (KV words use bits 0-27)
+    spec = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+    chunks = int(os.environ.get(
+        "BENCH_CHUNKS", str(max(1, C // 131072)) if on_accel else "1"
+    ))
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                     inbox_bound=spec.M - 1, coalesce_commit_refresh=True,
+                     wire_int16=False, fleet_chunks=chunks)
+    M, E = spec.M, spec.E
+    rnd = _jitted_kv_round(cfg, spec, kvspec, 0)
+    z2 = jnp.zeros((M, C), jnp.int32)
+    zp = jnp.zeros((M, E, C), jnp.int32)
+    no_hup = jnp.zeros((M, C), jnp.bool_)
+    no_tick = jnp.zeros((M, C), jnp.bool_)
+    keep = jnp.ones((M, M, C), jnp.bool_)
+    # one word per round, every group: rotate keys, vary payloads
+    words = [scheme.encode_put(r % keys, (100 + r) & scheme.MAX_VAL,
+                               r % (scheme.MAX_LEASE + 1))
+             for r in range(rounds)]
+
+    def fresh_fleet():
+        state = init_fleet(spec, C, seed=0, election_tick=cfg.election_tick)
+        inbox = empty_inbox(spec, C, wire_int16=False)
+        kv = init_kv(kvspec, C)
+        on = jnp.zeros((C,), jnp.bool_)
+        state, inbox, kv, _ = rnd(state, inbox, kv, on, z2, zp, zp, z2,
+                                  no_hup.at[0].set(True), no_tick, keep)
+        for _ in range(24):
+            state, inbox, kv, _ = rnd(state, inbox, kv, on, z2, zp, zp, z2,
+                                      no_hup, no_tick, keep)
+            if int((state.role == 3).sum()) == C:
+                break
+        assert int((state.role == 3).sum()) == C, "fleet failed to elect"
+        return state, inbox, kv
+
+    def run_mode(device: bool):
+        """One timed pass. Returns (elapsed_s, served_events,
+        digests_or_None). A write is 'served' once its watch delta is
+        visible past the device boundary (device: the per-round delta
+        count handoff; host: the per-group watcher buffers)."""
+        state, inbox, kv = fresh_fleet()
+        do_apply = jnp.full((C,), device, jnp.bool_)
+        hosts = None
+        if not device:
+            hosts = []
+            for _ in range(C):
+                ws = WatchableStore(MVCCStore())
+                w = ws.watch(scheme.key_bytes(0), b"\x00")
+                hosts.append((ws, w.id))
+            cursors = np.zeros(C, np.int64)
+        served = 0
+        L = spec.L
+        t0 = time.perf_counter()
+        for r in range(rounds + 4):  # +4 drain rounds: commit lags 2
+            w = words[r] if r < rounds else 0
+            pl = z2.at[0].set(1) if r < rounds else z2
+            pd = zp.at[0, 0].set(w) if r < rounds else zp
+            state, inbox, kv, delta = rnd(
+                state, inbox, kv, do_apply, pl, pd, zp, z2, no_hup,
+                no_tick, keep,
+            )
+            if device:
+                served += int(delta.mask.sum())  # the per-round handoff
+            else:
+                applied = np.asarray(state.applied[0])
+                ld = np.asarray(state.log_data[0])
+                for g in range(C):
+                    ws, wid = hosts[g]
+                    hi = int(applied[g])
+                    for idx in range(int(cursors[g]) + 1, hi + 1):
+                        word = int(ld[(idx - 1) % L, g])
+                        if word:
+                            op = scheme.decode(word)
+                            txn = ws.kv.write_txn()
+                            txn.put(scheme.key_bytes(op["key"]),
+                                    scheme.encode_value(op["val"]),
+                                    op["lease"])
+                            txn.end()
+                            ws.notify(txn.events)
+                    cursors[g] = hi
+                    # drain per round: "served" = delivered to the
+                    # consumer (and the buffer never saturates at
+                    # Watcher.MAX_BUFFER on long runs)
+                    served += len(ws.take_events(wid))
+        jax.block_until_ready(state.commit)
+        elapsed = time.perf_counter() - t0
+        if device:
+            digs = np.asarray(kv_digest(kvspec, kv))
+        else:
+            digs = np.asarray([
+                scheme.store_latest_digest(ws.kv, keys)
+                for ws, _wid in hosts[:64]
+            ])
+        return elapsed, served, digs
+
+    rep = {
+        "metric": "served_writes_per_sec",
+        "unit": (
+            "committed+applied+watch-delta writes/s "
+            f"(C={C}, rounds={rounds}, keys={keys}, {platform}; "
+            "baseline = reference's 10k writes/s headline)"
+        ),
+        "C": C, "rounds": rounds, "keys": keys, "platform": platform,
+    }
+    mode = knobs["mode"]
+    want = rounds * C
+    if mode in ("device", "both"):
+        el, served, ddigs = run_mode(device=True)
+        rep["device_writes_per_sec"] = round(want / el, 1)
+        rep["device_elapsed_s"] = round(el, 3)
+        rep["device_served_events"] = served
+        rep["device_served_ok"] = served == want
+    if mode in ("host", "both"):
+        el, served, hdigs = run_mode(device=False)
+        rep["host_writes_per_sec"] = round(want / el, 1)
+        rep["host_elapsed_s"] = round(el, 3)
+        rep["host_served_events"] = served
+        rep["host_served_ok"] = served == want
+    if mode == "both":
+        n = min(64, C)
+        rep["digests_match"] = bool((ddigs[:n] == hdigs[:n]).all())
+        rep["digest_lanes_checked"] = n
+        rep["device_vs_host_speedup"] = round(
+            rep["device_writes_per_sec"] / rep["host_writes_per_sec"], 2
+        )
+        rep["vs_baseline"] = round(
+            rep["device_writes_per_sec"] / BASELINE_WRITES_PER_SEC, 2
+        )
+    print(json.dumps(rep))
 # Driver-set stretch goal: 1M groups x 10k lockstep rounds/s on v5e-8
 NORTH_STAR_GROUP_ROUNDS_PER_SEC = 1_000_000 * 10_000
 
 
 def main() -> None:
     import dataclasses as _dc
+
+    # APPLY_* knob validation FIRST — a bad knob exits 2 before any
+    # device work (tested in tests/test_device_mvcc.py)
+    apply_knobs = _apply_knobs()
 
     from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
     from etcd_tpu.parallel.mesh import build_scan_rounds, make_fleet_mesh, shard_fleet
@@ -52,6 +255,8 @@ def main() -> None:
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
+    if apply_knobs["mode"] != "off":
+        return _apply_bench(apply_knobs, platform, on_accel)
     # clusters-minor layout: the huge C axis is last, so TPU (8,128) tiling
     # pads only the tiny member axes (<=1.6x) and C can grow toward the 1M
     # north-star without tile-padding blowup.

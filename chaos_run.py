@@ -25,9 +25,16 @@ Membership tier: CHAOS_C=4096 CHAOS_CRASH=0.01 CHAOS_MEMBER=0.05 \\
   the liveness floor defaults to the tier's conscious 0.1 instead of
   0.2 — membership churn legally starves fault epochs harder.)
 
+KV apply-plane self-check tier: APPLY_KEYS > 0 runs the device-vs-host
+differential parity pass (etcd_tpu/device_mvcc/fuzz.py — the same
+harness the fuzz suite drives) after the chaos run and folds a
+``kv_plane`` report plus an ``apply_parity_ok`` gate into the JSON line:
+  APPLY_KEYS=64 APPLY_GROUPS=256 APPLY_OPS=200 python chaos_run.py
+(APPLY_KEYS=0, the default, skips the tier.)
+
 All knobs are validated up front: a probability outside [0, 1], a boost
-below 1, or an unknown mix/durability name exits 2 before any device
-work.
+below 1, an unknown mix/durability name, or an out-of-range APPLY_*
+value exits 2 before any device work.
 """
 from __future__ import annotations
 
@@ -39,24 +46,15 @@ import time
 import jax
 
 
-def _knob_error(msg: str) -> "NoReturn":  # noqa: F821 — py3.9 compat
-    print(f"chaos_run: {msg}", file=sys.stderr)
-    raise SystemExit(2)
+import functools
 
+from etcd_tpu.utils.knobs import env_float, env_int, knob_error
 
-def _env_float(name: str, default: str, lo: float | None = None,
-               hi: float | None = None) -> float:
-    raw = os.environ.get(name, default)
-    try:
-        v = float(raw)
-    except ValueError:
-        _knob_error(f"{name}={raw!r} is not a number")
-    if v != v:  # NaN compares False against any range bound
-        _knob_error(f"{name}={raw!r} is not a number")
-    if lo is not None and v < lo or hi is not None and v > hi:
-        span = (f"[{lo}, {hi}]" if hi is not None else f">= {lo}")
-        _knob_error(f"{name}={raw} outside {span}")
-    return v
+# the shared exit-2-before-device-work validation pattern
+# (etcd_tpu/utils/knobs.py), bound to this driver's name
+_knob_error = functools.partial(knob_error, "chaos_run")
+_env_float = functools.partial(env_float, "chaos_run")
+_env_int = functools.partial(env_int, "chaos_run")
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
@@ -123,6 +121,15 @@ def main() -> int:
         )
     except ValueError as e:
         _knob_error(str(e))
+    # KV apply-plane tier knobs (device_mvcc differential parity pass);
+    # APPLY_KEYS caps at the 9-bit op-word key field (scheme.MAX_KEYS)
+    apply_knobs = {
+        name: _env_int(name, default, lo, hi)
+        for name, default, lo, hi in (("APPLY_KEYS", "0", 0, 511),
+                                      ("APPLY_GROUPS", "256", 1, None),
+                                      ("APPLY_OPS", "200", 1, None))
+    }
+
     env_w16 = os.environ.get("CHAOS_WIRE16")
     if member_p > 0 and env_w16 is not None and env_w16 != "0":
         # same truthiness rule as the parse below — any non-"0" value
@@ -234,8 +241,33 @@ def main() -> int:
     else:
         rep["lease_safe"] = True
 
+    # KV apply-plane differential parity tier (device_mvcc/fuzz.py): the
+    # device revision store vs per-schedule host MVCCStore replays under
+    # the shared canonical digest — proves the served-write plane's apply
+    # semantics on THIS platform alongside the chaos evidence. Degrades
+    # gracefully like the lease tier: a tier failure must not discard the
+    # device tier's results.
+    if apply_knobs["APPLY_KEYS"] > 0:
+        try:
+            from etcd_tpu.device_mvcc import KVSpec
+            from etcd_tpu.device_mvcc.fuzz import differential_run
+
+            rep["kv_plane"] = differential_run(
+                KVSpec(keys=apply_knobs["APPLY_KEYS"]),
+                groups=apply_knobs["APPLY_GROUPS"],
+                ops=apply_knobs["APPLY_OPS"],
+                seed=int(os.environ.get("CHAOS_SEED", "0")),
+            )
+            rep["apply_parity_ok"] = rep["kv_plane"]["parity_ok"]
+        except Exception as e:  # noqa: BLE001
+            rep["apply_parity_ok"] = False
+            rep["kv_plane_error"] = f"{type(e).__name__}: {e}"[-500:]
+    else:
+        rep["apply_parity_ok"] = True
+
     print(json.dumps(rep))
-    ok = rep["safe"] and rep["recovered"] and rep["lively"] and rep["lease_safe"]
+    ok = (rep["safe"] and rep["recovered"] and rep["lively"]
+          and rep["lease_safe"] and rep["apply_parity_ok"])
     return 0 if ok else 1
 
 

@@ -1,0 +1,35 @@
+"""Device-resident MVCC apply plane.
+
+Re-expresses the host MVCC apply path (etcd_tpu/server/mvcc.py) as
+batched JAX tensors riding the same ``[clusters x members]`` fleet as the
+consensus engine: a fixed-key-space revision store with vmapped txn
+apply, compaction as a masked scatter with ErrCompacted/ErrFutureRev
+status lanes, a shared canonical digest, and device-side watch-delta
+extraction — so a committed entry becomes a *served write* without
+leaving the chip (ROADMAP: "Device-resident apply plane").
+
+Modules:
+  scheme  — canonical key/value/word codec + the shared digest fold
+            (pure python; both planes import it)
+  state   — KVSpec / KVState pytree (clusters-minor, engine layout)
+  apply   — apply_word / apply_words / read_at / kv_digest /
+            extract_deltas (the jnp kernels)
+  facade  — DevicePlane, the imperative per-lane host surface kvserver's
+            DeviceBackedStore sits on
+  fuzz    — differential schedule generator + host replay (shared by
+            tests/test_device_mvcc.py and chaos_run.py's APPLY tier)
+
+Engine integration: models/engine.py build_kv_round consumes committed
+entry words straight from the apply frontier, host-apply vs device-apply
+selected by a runtime operand (one trace serves both).
+"""
+from etcd_tpu.device_mvcc.state import KVSpec, KVState, init_kv  # noqa: F401
+from etcd_tpu.device_mvcc.apply import (  # noqa: F401
+    WatchDelta,
+    apply_word,
+    apply_words,
+    extract_deltas,
+    kv_digest,
+    read_at,
+)
+from etcd_tpu.device_mvcc.facade import DevicePlane  # noqa: F401

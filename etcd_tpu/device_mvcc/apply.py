@@ -1,0 +1,267 @@
+"""Vmapped txn apply, compaction scatter, digest and watch-delta scan.
+
+One committed int32 entry word (scheme.py codec) is one MVCC operation;
+``apply_word`` applies one word across the whole ``[keys, C]`` fleet as
+straight-line masked tensor updates — the device twin of
+``MVCCStore.WriteTxn`` (etcd_tpu/server/mvcc.py):
+
+  * revisions ``{main, sub}`` are preserved semantically: a word with the
+    CONT bit continues the previous word's txn (same main, next sub —
+    intra-txn op order), a word without it opens a new txn at
+    ``current_rev + 1``; ``current_rev`` advances only when the txn wrote
+    (WriteTxn.end()).  The latest record stores main exactly as
+    mvccpb.KeyValue.mod_revision does; sub never escapes the host store's
+    rev-keyed index either.
+  * put: read-your-writes against the live store (earlier words of the
+    same txn already landed), version bump iff the key is live, fresh
+    generation (create=main, version=1) after a tombstone — key_index.go
+    semantics without the generation lists.
+  * delete-range: one masked interval tombstone write; only live keys
+    count toward deleted (and toward the txn's wrote flag).
+  * compact: ``ErrCompacted``/``ErrFutureRev`` become per-group status
+    lanes (counters — the batched form of the host's raised exceptions),
+    then a masked scatter clears keys whose latest record is a tombstone
+    at or below the compaction floor (kvstore_compaction.go's
+    "drop whole keys whose latest is a tombstone").
+
+``kv_digest`` is the device half of the shared canonical digest
+(scheme.latest_digest); ``extract_deltas`` is the per-round watch delta
+scan — keys whose mod_revision moved past the previous round's revision
+cursor, revision-coalesced (one event per key per round, carrying the
+newest record; the host watch facade fans these out,
+server/watch.py:events_from_delta).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from etcd_tpu.device_mvcc import scheme
+from etcd_tpu.device_mvcc.state import KVSpec, KVState
+
+
+def _i32c(x: int) -> jnp.ndarray:
+    return jnp.int32(scheme.i32(x))
+
+
+def _value_hash32(val: jnp.ndarray) -> jnp.ndarray:
+    """jnp twin of scheme.value_hash32 (int32 wrap == u32 congruence)."""
+    val = val.astype(jnp.int32)
+    return (val * _i32c(scheme.MIX_A)) ^ (val + _i32c(scheme.MIX_B))
+
+
+def _record_mix(key, mod, create, version, vword, lease, tomb):
+    """jnp twin of scheme.record_mix — keep line-for-line congruent."""
+    h = key * _i32c(scheme.MIX_A) + mod * _i32c(scheme.MIX_B)
+    h = h ^ (create * _i32c(scheme.MIX_C) + version * _i32c(scheme.MIX_D) + 7)
+    h = h * _i32c(scheme.MIX_C) + (
+        _value_hash32(vword) ^ (lease * _i32c(scheme.MIX_E))
+    )
+    return h + tomb.astype(jnp.int32) * _i32c(scheme.MIX_D)
+
+
+def apply_word(kvspec: KVSpec, st: KVState, word: jnp.ndarray,
+               active: jnp.ndarray) -> KVState:
+    """Apply one op word per group. ``word``/``active`` are [C] (scalar
+    broadcasts fine); inactive lanes (and NOP/unparseable kinds) are
+    identity. Pure elementwise over [keys, C] — no gathers: the key axis
+    is small, so one-hot masks beat scatter lowering on TPU."""
+    K = kvspec.keys
+    word = jnp.asarray(word, jnp.int32)
+    active = jnp.asarray(active, jnp.bool_)
+    word, active = jnp.broadcast_arrays(word, active.astype(jnp.bool_))
+
+    kind = word & 3
+    cont = (word & scheme.CONT_BIT) != 0
+    key = (word >> scheme.KEY_SHIFT) & scheme.MAX_KEYS
+    val = (word >> scheme.VAL_SHIFT) & scheme.MAX_VAL
+    lease = (word >> scheme.LEASE_SHIFT) & scheme.MAX_LEASE
+    hi = (word >> scheme.HI_SHIFT) & ((1 << scheme.HI_BITS) - 1)
+    crev = (word >> scheme.REV_SHIFT) & scheme.MAX_COMPACT_REV
+
+    is_put = active & (kind == scheme.KIND_PUT)
+    is_del = active & (kind == scheme.KIND_DELETE)
+    is_cmp = active & (kind == scheme.KIND_COMPACT)
+
+    # txn main: a CONT word shares the OPEN txn's main (WriteTxn.main);
+    # anything else — including a CONT with no txn open (first word, or
+    # right after a compact closed it) — opens a fresh txn at
+    # current_rev + 1, exactly like the host replay reopening after
+    # end(). txn_main == 0 means "no open txn".
+    has_txn = cont & (st.txn_main > 0)
+    main = jnp.where(has_txn, st.txn_main, st.current_rev + 1)    # [C]
+
+    ids = jnp.arange(K, dtype=jnp.int32)[:, None]                  # [K, 1]
+    live = st.present & ~st.tomb                                   # [K, C]
+
+    # ---- put --------------------------------------------------------------
+    pmask = is_put[None, :] & (ids == key[None, :])                # [K, C]
+    new_present = st.present | pmask
+    new_tomb = st.tomb & ~pmask
+    new_mod = jnp.where(pmask, main[None, :], st.mod)
+    # fresh generation after absence/tombstone: create=main, version=1;
+    # live key: create kept, version + 1 (key_index.go created_version)
+    new_create = jnp.where(pmask, jnp.where(live, st.create, main[None, :]),
+                           st.create)
+    new_version = jnp.where(pmask, jnp.where(live, st.version + 1, 1),
+                            st.version)
+    new_vword = jnp.where(pmask, val[None, :], st.vword)
+    new_lease = jnp.where(pmask, lease[None, :], st.lease)
+
+    # ---- delete-range -----------------------------------------------------
+    dmask = (
+        is_del[None, :] & live
+        & (ids >= key[None, :]) & (ids < hi[None, :])
+    )                                                              # [K, C]
+    deleted_any = dmask.any(axis=0)                                # [C]
+    # tombstoned keys stay present (in the index) until compaction
+    new_tomb = new_tomb | dmask
+    new_mod = jnp.where(dmask, main[None, :], new_mod)
+    # host tombstone KeyValue: (key, b"", create=0, mod=rev, version=0,
+    # lease=0) — mirror the zeroed fields exactly or digests diverge
+    new_create = jnp.where(dmask, 0, new_create)
+    new_version = jnp.where(dmask, 0, new_version)
+    new_vword = jnp.where(dmask, 0, new_vword)
+    new_lease = jnp.where(dmask, 0, new_lease)
+
+    wrote = is_put | (is_del & deleted_any)
+    new_current = jnp.where(wrote, main, st.current_rev)
+    # a compact CLOSES the open txn (host replay ends it before
+    # compacting), so a later CONT word cannot bind to a stale main
+    new_txn_main = jnp.where(
+        is_put | is_del, main,
+        jnp.where(is_cmp, 0, st.txn_main),
+    )
+
+    # ---- compact ----------------------------------------------------------
+    bad_c = is_cmp & (crev <= st.compact_rev)   # mvcc.ErrCompacted
+    bad_f = is_cmp & (crev > st.current_rev)    # mvcc.ErrFutureRev
+    ok_cmp = is_cmp & ~bad_c & ~bad_f
+    new_compact = jnp.where(ok_cmp, crev, st.compact_rev)
+    # masked scatter: whole keys whose latest is a tombstone at/below the
+    # floor drop out of the index (kvstore_compaction.go); live keys keep
+    # their latest record, exactly like KeyIndex.compact keeps it
+    gone = ok_cmp[None, :] & st.tomb & (st.mod <= crev[None, :])
+    new_present = new_present & ~gone
+    new_tomb = new_tomb & ~gone
+    new_mod = jnp.where(gone, 0, new_mod)
+
+    return st.replace(
+        present=new_present, tomb=new_tomb, mod=new_mod, create=new_create,
+        version=new_version, vword=new_vword, lease=new_lease,
+        current_rev=new_current, compact_rev=new_compact,
+        txn_main=new_txn_main,
+        err_compacted=st.err_compacted + bad_c.astype(jnp.int32),
+        err_future=st.err_future + bad_f.astype(jnp.int32),
+    )
+
+
+def apply_words(kvspec: KVSpec, st: KVState, words: jnp.ndarray,
+                active: jnp.ndarray | None = None) -> KVState:
+    """Apply a word stream [N, C] (each group its own schedule down axis 0
+    — the differential fuzz layout). ``active`` [N, C] masks individual
+    ops; None = all on."""
+    words = jnp.asarray(words, jnp.int32)
+    if active is None:
+        active = jnp.ones(words.shape, jnp.bool_)
+
+    def body(carry, wa):
+        w, a = wa
+        return apply_word(kvspec, carry, w, a), None
+
+    st, _ = jax.lax.scan(body, st, (words, jnp.asarray(active, jnp.bool_)))
+    return st
+
+
+# ---------------------------------------------------------------------------
+# reads
+# ---------------------------------------------------------------------------
+
+
+def check_rev(st: KVState, rev: jnp.ndarray):
+    """The host's _check_rev window test as status lanes:
+    (err_future, err_compacted, at) for a requested read revision
+    (rev <= 0 means current). ``at`` is the served revision."""
+    rev = jnp.broadcast_to(jnp.asarray(rev, jnp.int32), st.current_rev.shape)
+    cur = jnp.where(rev <= 0, st.current_rev, rev)
+    err_f = cur > st.current_rev
+    at = jnp.where(err_f, st.current_rev, cur)
+    err_c = at < st.compact_rev
+    return err_f, err_c, at
+
+
+def read_at(kvspec: KVSpec, st: KVState, rev: jnp.ndarray = 0):
+    """Visibility mask at a revision: (visible [keys, C], unservable
+    [keys, C], err_future [C], err_compacted [C]).
+
+    The latest-only store serves a key at ``rev`` exactly when its latest
+    record is at or below ``rev`` (nothing newer exists to mask) — always
+    true at the current revision.  A matching key whose mod_revision is
+    ABOVE ``rev`` is flagged ``unservable``: its state at ``rev`` was
+    compacted-to-latest by construction, and the honest etcd-shaped
+    answer is ErrCompacted (the plane's per-key compaction floor is the
+    latest record).  The host facade (server/mvcc.py DeviceBackedStore)
+    raises on any unservable hit rather than returning wrong data."""
+    err_f, err_c, at = check_rev(st, rev)
+    reach = st.present & (st.mod <= at[None, :])
+    visible = reach & ~st.tomb
+    unservable = st.present & (st.mod > at[None, :])
+    return visible, unservable, err_f, err_c
+
+
+# ---------------------------------------------------------------------------
+# digest (device half of the shared canonical fold)
+# ---------------------------------------------------------------------------
+
+
+def kv_digest(kvspec: KVSpec, st: KVState) -> jnp.ndarray:
+    """[C] i32 — bit-equal to scheme.store_latest_digest of a host store
+    that applied the same words (the equivalence gate of
+    tests/test_device_mvcc.py)."""
+    K = kvspec.keys
+    ids = jnp.arange(K, dtype=jnp.int32)[:, None]
+    mix = _record_mix(ids, st.mod, st.create, st.version, st.vword,
+                      st.lease, st.tomb)
+    s = (mix * st.present.astype(jnp.int32)).sum(
+        axis=0, dtype=jnp.int32
+    )
+    h = s * _i32c(scheme.MIX_C) + st.current_rev * _i32c(scheme.MIX_A)
+    return h ^ (st.compact_rev * _i32c(scheme.MIX_E) + _i32c(scheme.MIX_B))
+
+
+# ---------------------------------------------------------------------------
+# watch deltas (device-side delta scan)
+# ---------------------------------------------------------------------------
+
+
+class WatchDelta(struct.PyTreeNode):
+    """Per-round [keys, C] delta tensors the host watch facade fans out.
+    ``mask`` selects keys whose latest record moved past ``rev_floor``
+    this round; ``tomb`` distinguishes delete events. Coalesced by
+    revision: one event per key per round, carrying the newest record."""
+
+    mask: jnp.ndarray      # bool[K, C]
+    tomb: jnp.ndarray      # bool[K, C]
+    mod: jnp.ndarray       # i32[K, C]
+    create: jnp.ndarray    # i32[K, C]
+    version: jnp.ndarray   # i32[K, C]
+    vword: jnp.ndarray     # i32[K, C]
+    lease: jnp.ndarray     # i32[K, C]
+    rev_floor: jnp.ndarray  # i32[C] — deltas cover (rev_floor, current_rev]
+
+
+def extract_deltas(kvspec: KVSpec, rev_floor: jnp.ndarray,
+                   st: KVState) -> WatchDelta:
+    """Keys whose latest record landed after ``rev_floor`` (usually the
+    previous round's current_rev). Compaction never fires a delta (it
+    clears mod to 0, below any floor)."""
+    rev_floor = jnp.broadcast_to(
+        jnp.asarray(rev_floor, jnp.int32), st.current_rev.shape
+    )
+    mask = st.present & (st.mod > rev_floor[None, :])
+    return WatchDelta(
+        mask=mask, tomb=st.tomb & mask, mod=st.mod, create=st.create,
+        version=st.version, vword=st.vword, lease=st.lease,
+        rev_floor=rev_floor,
+    )

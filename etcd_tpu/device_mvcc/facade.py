@@ -1,0 +1,117 @@
+"""Host facade over a fleet of device-resident MVCC stores.
+
+``DevicePlane`` owns one ``KVState`` fleet (C lanes, clusters-minor) and
+gives host code an imperative per-lane surface: encode the op, dispatch
+ONE jitted masked apply, read back the lanes it needs.  This is the
+kvserver-facing half of the apply plane — the batched/high-throughput
+path goes through ``models/engine.py:build_kv_round`` instead and never
+leaves the device.
+
+Programs are cached per KVSpec (module-level lru_cache, mirroring
+engine._jitted_round): every EtcdCluster in a suite shares two compiled
+programs (apply + digest) per key-space size.
+
+Layering: this module returns plain numpy records; the KeyValue/Event
+materialization lives in the server layer (server/mvcc.py
+DeviceBackedStore, server/watch.py events_from_delta) so device_mvcc
+never imports server code.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from etcd_tpu.device_mvcc import scheme
+from etcd_tpu.device_mvcc.apply import apply_word, kv_digest
+from etcd_tpu.device_mvcc.state import KVSpec, KVState, init_kv
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_apply(kvspec: KVSpec):
+    return jax.jit(functools.partial(apply_word, kvspec))
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_digest(kvspec: KVSpec):
+    return jax.jit(functools.partial(kv_digest, kvspec))
+
+
+class DevicePlane:
+    """C independent device MVCC lanes (one per hosted member)."""
+
+    def __init__(self, kvspec: KVSpec | None = None, C: int = 1):
+        self.kvspec = kvspec or KVSpec()
+        self.C = C
+        self.st = init_kv(self.kvspec, C)
+        self._apply = _jitted_apply(self.kvspec)
+        self._digest = _jitted_digest(self.kvspec)
+
+    # -- raw word application ----------------------------------------------
+    def apply_word_lane(self, lane: int, word: int) -> None:
+        active = jnp.zeros((self.C,), jnp.bool_).at[lane].set(True)
+        self.st = self._apply(self.st, jnp.int32(word), active)
+
+    # -- lane readbacks ------------------------------------------------------
+    def current_rev(self, lane: int) -> int:
+        return int(np.asarray(self.st.current_rev[lane]))
+
+    def compact_rev(self, lane: int) -> int:
+        return int(np.asarray(self.st.compact_rev[lane]))
+
+    def err_counts(self, lane: int) -> tuple[int, int]:
+        return (
+            int(np.asarray(self.st.err_compacted[lane])),
+            int(np.asarray(self.st.err_future[lane])),
+        )
+
+    def digest(self, lane: int) -> int:
+        return int(np.asarray(self._digest(self.st)[lane]))
+
+    def records(self, lane: int) -> dict[int, dict]:
+        """Latest records of one lane: {key_id: {mod, create, version,
+        vword, lease, tomb}} for present keys (tombstones included)."""
+        sub = jax.tree.map(lambda x: np.asarray(x[..., lane]), self.st)
+        out = {}
+        for kid in np.nonzero(sub.present)[0]:
+            kid = int(kid)
+            out[kid] = {
+                "mod": int(sub.mod[kid]),
+                "create": int(sub.create[kid]),
+                "version": int(sub.version[kid]),
+                "vword": int(sub.vword[kid]),
+                "lease": int(sub.lease[kid]),
+                "tomb": bool(sub.tomb[kid]),
+            }
+        return out
+
+    # -- lane restore (peer-snapshot install path) --------------------------
+    def load_lane(self, lane: int, records: dict[int, dict],
+                  current_rev: int, compact_rev: int) -> None:
+        """Overwrite one lane from latest-record tuples (the applySnapshot
+        analog for the device plane: the lane jumps to the snapshot)."""
+        K = self.kvspec.keys
+        cols = {
+            "present": np.zeros(K, bool), "tomb": np.zeros(K, bool),
+            "mod": np.zeros(K, np.int32), "create": np.zeros(K, np.int32),
+            "version": np.zeros(K, np.int32), "vword": np.zeros(K, np.int32),
+            "lease": np.zeros(K, np.int32),
+        }
+        for kid, r in records.items():
+            cols["present"][kid] = True
+            cols["tomb"][kid] = r["tomb"]
+            for f in ("mod", "create", "version", "vword", "lease"):
+                cols[f][kid] = r[f]
+        upd = {}
+        for f, col in cols.items():
+            leaf = np.array(getattr(self.st, f))
+            leaf[:, lane] = col
+            upd[f] = jnp.asarray(leaf)
+        for f, v in (("current_rev", current_rev),
+                     ("compact_rev", compact_rev), ("txn_main", 0)):
+            leaf = np.array(getattr(self.st, f))
+            leaf[lane] = v
+            upd[f] = jnp.asarray(leaf)
+        self.st = self.st.replace(**upd)

@@ -1,0 +1,87 @@
+"""Device-resident MVCC revision store as a struct-of-arrays pytree.
+
+The batched analog of ``MVCCStore`` (etcd_tpu/server/mvcc.py) restricted
+to the canonical fixed key space (device_mvcc/scheme.py): one group's
+store is a bundle of ``[keys]`` per-key lanes plus per-group revision
+cursors; a fleet is the same pytree with the clusters axis MINOR
+(``[keys, C]`` / ``[C]`` leaves), matching the engine's clusters-minor
+layout (models/engine.py: TPU (8,128) tiling pads only the small keys
+axis, and the apply kernel slots into the round program with the same
+``in_axes=-1`` convention).
+
+Latest-record semantics: each key slot holds the key's NEWEST revision
+record — exactly what ``mvccpb.KeyValue`` carries (mod/create/version/
+value/lease) plus the tombstone mask that stands in for an uncompacted
+tombstone generation.  History below the latest record is not
+materialized on device; reads below a key's mod_revision answer
+``ErrCompacted`` (the plane's effective per-key compaction floor is the
+latest record — see apply.read_at).  Everything the digest, the watch
+delta scan, and the served-write path need IS the latest record, which
+is what makes the fixed-width layout possible.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from flax import struct
+
+from etcd_tpu.device_mvcc import scheme
+
+
+@dataclasses.dataclass(frozen=True)
+class KVSpec:
+    """Static shape parameters of the device revision store (the Spec
+    analog for the apply plane; array shapes + trace structure only)."""
+
+    keys: int = 64  # fixed key-space size (canonical slots 0..keys-1)
+
+    def __post_init__(self):
+        if not 1 <= self.keys <= scheme.MAX_KEYS:
+            raise ValueError(
+                f"KVSpec.keys ({self.keys}) outside [1, {scheme.MAX_KEYS}] "
+                "(the op-word key field is 9 bits)"
+            )
+
+
+class KVState(struct.PyTreeNode):
+    # --- per-key latest records (mvccpb.KeyValue analog), [keys, C] --------
+    present: jnp.ndarray   # bool: key exists in the index (incl. tombstoned)
+    tomb: jnp.ndarray      # bool: latest record is an uncompacted tombstone
+    mod: jnp.ndarray       # i32 mod_revision (main)
+    create: jnp.ndarray    # i32 create_revision (0 for tombstones)
+    version: jnp.ndarray   # i32 (0 for tombstones)
+    vword: jnp.ndarray     # i32 value word (the replicated value reference)
+    lease: jnp.ndarray     # i32 lease id (0 = none)
+
+    # --- per-group cursors (kvstore.go:59-87 analog), [C] ------------------
+    current_rev: jnp.ndarray  # i32, boots at 1 like the reference
+    compact_rev: jnp.ndarray  # i32
+    txn_main: jnp.ndarray     # i32 revision main of the open txn (CONT words)
+
+    # --- per-group status lanes (host exceptions become counters) ----------
+    err_compacted: jnp.ndarray  # i32 ErrCompacted count (compact below floor)
+    err_future: jnp.ndarray     # i32 ErrFutureRev count (compact past head)
+
+    # --- engine apply-frontier bookkeeping, [C] ----------------------------
+    applied_idx: jnp.ndarray  # i32 log index applied into this store
+    skipped: jnp.ndarray      # i32 words lost to ring-overwrite overrun
+    desynced: jnp.ndarray     # bool, sticky: the bound member installed a
+    #   peer snapshot (applied jumped > Spec.A in one round) — its ring
+    #   slots no longer index-match, so the lane FREEZES instead of
+    #   replaying stale words (engine.build_kv_round)
+
+
+def init_kv(kvspec: KVSpec, C: int) -> KVState:
+    """Fresh fleet store: empty key space at revision 1."""
+    K = kvspec.keys
+    zKC = jnp.zeros((K, C), jnp.int32)
+    fKC = jnp.zeros((K, C), jnp.bool_)
+    zC = jnp.zeros((C,), jnp.int32)
+    return KVState(
+        present=fKC, tomb=fKC, mod=zKC, create=zKC, version=zKC,
+        vword=zKC, lease=zKC,
+        current_rev=jnp.ones((C,), jnp.int32), compact_rev=zC,
+        txn_main=zC, err_compacted=zC, err_future=zC,
+        applied_idx=zC, skipped=zC, desynced=jnp.zeros((C,), jnp.bool_),
+    )

@@ -29,6 +29,7 @@ from etcd_tpu.ops.outbox import Outbox
 from etcd_tpu.types import (
     ENT_FIELDS,
     ENTRY_CONF_CHANGE,
+    ENTRY_NORMAL,
     MSG_SNAP,
     Msg,
     NONE_ID,
@@ -469,6 +470,114 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
         return state, inbox
 
     return round_fn
+
+
+def build_kv_round(cfg: RaftConfig, spec: Spec, kvspec, member: int = 0):
+    """Round step with the device-resident MVCC apply plane fused in:
+    consensus round, then up to Spec.A committed entry words consumed
+    straight from ``member``'s apply frontier into a
+    ``device_mvcc.KVState`` fleet, then the watch-delta scan.
+
+    Returns kv_round_fn(state, inbox, kv, do_apply, *round_args) ->
+    (state, inbox, kv, delta). ``do_apply`` is a RUNTIME operand ([C]
+    bool or scalar): False leaves the KV fleet untouched, so ONE traced
+    program serves both apply modes (host-apply pulls the same words
+    through numpy, exactly like kvserver._pump) — the same
+    one-trace/many-operands discipline as the chaos knobs.
+
+    The plane consumes entries in (kv.applied_idx, state.applied[member]]
+    — the entries the node itself just applied to its hash chain
+    (models/raft.py apply_round), so the KV store advances at the same
+    <=A-per-round cadence and words can never outrun it.  Ring
+    compaction only moves cursors (snap_index), never scrubs slots, so
+    the plane may read below snap_index; a word is lost only once a
+    newer entry physically overwrites its slot (idx <= last_index - L).
+    Lost words are counted in kv.skipped and the cursor jumps
+    (unreachable while the apply cadence A covers the per-round append
+    rate, as every current caller does).
+
+    PEER SNAPSHOTS: a member that installs MsgSnap (models/raft.py
+    handle_snapshot) keeps its old ring bytes under new cursors, so its
+    slots no longer index-match and replay would corrupt the lane.  The
+    plane binds to a member that must not be a snapshot receiver (every
+    current caller binds the leader lane); installs are DETECTED by the
+    one sound signal available — ring apply advances `applied` by at
+    most Spec.A per round, so a larger jump can only be an install —
+    and the lane freezes with the sticky kv.desynced flag set rather
+    than diverging silently.  An install whose jump happens to be <= A
+    escapes this detector; recovering a desynced lane needs a KV-state
+    snapshot transfer (ROADMAP apply-plane follow-ons).  Conf-change
+    and empty (leader-election) entries decode as NOPs by construction.
+
+    KV words exceed the int16 wire (scheme.py layout: up to 28 bits), so
+    device-apply fleets require wire_int16=False — same rule, same
+    reason as the membership chaos tier's conf-change words.
+    """
+    from etcd_tpu.device_mvcc.apply import apply_word, extract_deltas
+
+    if cfg.wire_int16:
+        raise ValueError(
+            "build_kv_round needs the int32 wire (KV op words use bits "
+            "0-27); construct the engine with wire_int16=False"
+        )
+    base = build_round(cfg, spec)
+    L = spec.L
+
+    def kv_round_fn(state, inbox, kv, do_apply, *args):
+        pre_applied = state.applied[member]            # [C]
+        state, inbox = base(state, inbox, *args)
+        do_apply = jnp.broadcast_to(
+            jnp.asarray(do_apply, jnp.bool_), kv.current_rev.shape
+        )
+        rev_floor = kv.current_rev
+        applied = state.applied[member]                # [C]
+        ld = state.log_data[member]                    # [L, C]
+        lt = state.log_type[member]                    # [L, C]
+        # snapshot-install detector (see docstring): ring apply can
+        # advance `applied` by at most A per round — a bigger jump means
+        # handle_snapshot fired and the ring no longer index-matches
+        kv = kv.replace(desynced=kv.desynced | (
+            do_apply & (applied - pre_applied > spec.A)
+        ))
+        live = do_apply & ~kv.desynced
+        # ring-overwrite overrun: a slot is gone only once a newer entry
+        # physically lands on it — count the lost words, jump the cursor
+        floor = jnp.maximum(state.last_index[member] - L, 0)
+        lost = jnp.where(
+            live, jnp.maximum(floor - kv.applied_idx, 0), 0
+        )
+        kv = kv.replace(
+            skipped=kv.skipped + lost,
+            applied_idx=jnp.where(live,
+                                  jnp.maximum(kv.applied_idx, floor),
+                                  kv.applied_idx),
+        )
+
+        def body(kvc, _):
+            idx = kvc.applied_idx + 1
+            can = live & (idx <= applied)
+            slot = (idx - 1) % L                       # [C]
+            word = jnp.take_along_axis(ld, slot[None, :], axis=0)[0]
+            etype = jnp.take_along_axis(lt, slot[None, :], axis=0)[0]
+            word = jnp.where(can & (etype == ENTRY_NORMAL), word, 0)
+            kvc = apply_word(kvspec, kvc, word, can)
+            kvc = kvc.replace(
+                applied_idx=jnp.where(can, idx, kvc.applied_idx)
+            )
+            return kvc, None
+
+        kv, _ = jax.lax.scan(body, kv, None, length=spec.A)
+        delta = extract_deltas(kvspec, rev_floor, kv)
+        return state, inbox, kv, delta
+
+    return kv_round_fn
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_kv_round(cfg: RaftConfig, spec: Spec, kvspec, member: int = 0):
+    """One traced+jitted KV round program per (cfg, spec, kvspec, member)
+    — same sharing rationale as _jitted_round."""
+    return jax.jit(build_kv_round(cfg, spec, kvspec, member))
 
 
 @functools.lru_cache(maxsize=64)

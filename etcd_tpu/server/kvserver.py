@@ -166,6 +166,8 @@ class EtcdCluster:
         auth_token: str = "simple",
         auth_jwt_key: bytes | None = None,
         durable_proposes: bool = False,
+        apply_plane: str = "host",
+        kv_keys: int = 64,
     ):
         self.cl = cluster or Cluster(n_members=n_members)
         # acknowledged ⇒ on disk: fsync the members' backends before a
@@ -200,10 +202,31 @@ class EtcdCluster:
         import time as _time
 
         self.v2_now = _time.time
+        # apply_plane="device": each member's KV store is one lane of the
+        # device-resident apply plane (etcd_tpu/device_mvcc) behind the
+        # DeviceBackedStore facade — puts/deletes/compactions dispatch as
+        # int32 op words, reads/digests come back from device tensors,
+        # and watch events fan out of the per-op delta readbacks.  The
+        # host plane stays the default; the device plane serves the
+        # canonical key space only (scheme.key_bytes) and is exercised
+        # end-to-end by tests/test_device_mvcc.py.
+        if apply_plane not in ("host", "device"):
+            raise ServerError(f"unknown apply_plane {apply_plane!r}")
+        self.apply_plane = apply_plane
+        self.device_plane = None
+        if apply_plane == "device":
+            if data_dir:
+                raise ServerError(
+                    "apply_plane='device' has no backend persistence path "
+                    "yet; the durable floor is the device snapshot tier"
+                )
+            from etcd_tpu.device_mvcc import DevicePlane, KVSpec
+
+            self.device_plane = DevicePlane(KVSpec(keys=kv_keys), C=self.M)
         self.members = [
-            MemberState(WatchableStore(), Lessor(lease_min_ttl),
+            MemberState(self._fresh_store(m), Lessor(lease_min_ttl),
                         self._new_auth())
-            for _ in range(self.M)
+            for m in range(self.M)
         ]
         if data_dir:
             import os
@@ -228,6 +251,18 @@ class EtcdCluster:
 
     def _new_auth(self) -> AuthStore:
         return AuthStore(token=self.auth_token, jwt_key=self.auth_jwt_key)
+
+    def _fresh_store(self, m: int) -> WatchableStore:
+        """An empty applied KV store for member m — a host MVCCStore, or
+        (device plane) the member's device lane wiped back to boot state
+        (a crash drops the applied state machine either way; recovery is
+        ring replay or a peer snapshot through _pump)."""
+        if self.device_plane is None:
+            return WatchableStore()
+        from etcd_tpu.server.mvcc import DeviceBackedStore
+
+        self.device_plane.load_lane(m, {}, 1, 0)
+        return WatchableStore(DeviceBackedStore(self.device_plane, m))
 
     # ------------------------------------------------------------------ raft
     def leader(self) -> int:
@@ -426,7 +461,7 @@ class EtcdCluster:
         if ms.backend is not None:
             ms.backend._f.close()  # no commit: the pending batch is lost
         husk = MemberState(
-            WatchableStore(), Lessor(ms.lessor.min_ttl), self._new_auth()
+            self._fresh_store(m), Lessor(ms.lessor.min_ttl), self._new_auth()
         )
         husk.crashed = True
         self.members[m] = husk
@@ -449,7 +484,7 @@ class EtcdCluster:
             # peer snapshot restored (the bootstrapExistingClusterNoWAL
             # case of mustDetectDowngrade).
             husk = MemberState(
-                WatchableStore(),
+                self._fresh_store(m),
                 Lessor(self.members[m].lessor.min_ttl), self._new_auth(),
             )
             if m in self.server_versions:
@@ -681,10 +716,19 @@ class EtcdCluster:
         }
 
     def restore_member(self, m: int, snap: dict) -> None:
-        from etcd_tpu.server.mvcc import MVCCStore
+        from etcd_tpu.server.mvcc import DeviceBackedStore, MVCCStore
 
         ms = self.members[m]
-        ms.store.restore(MVCCStore.from_snapshot(snap["kv"]))
+        if self.device_plane is not None:
+            # install into the device lane, then re-sync watchers against
+            # the same facade object (the applySnapshot path, device form)
+            kv = ms.store.kv
+            if not isinstance(kv, DeviceBackedStore):
+                kv = DeviceBackedStore(self.device_plane, m)
+            kv.load_snapshot(snap["kv"])
+            ms.store.restore(kv)
+        else:
+            ms.store.restore(MVCCStore.from_snapshot(snap["kv"]))
         ms.lessor.restore(snap["lease"])
         ms.auth.restore(snap["auth"])
         ms.alarms = set(snap["alarms"])

@@ -96,14 +96,6 @@ class KeyIndex:
         hit = self._walk(at_rev)
         return hit[1][-1] if hit else None
 
-    def created_version(self, at_rev: int) -> tuple[Revision, int] | None:
-        """(create_revision, version) for the generation live at at_rev."""
-        hit = self._walk(at_rev)
-        if not hit:
-            return None
-        gi, vis = hit
-        return self.generations[gi][0], len(vis)
-
     def compact(self, at_rev: int) -> bool:
         """Drop revisions <= at_rev except the live one; returns True when
         the whole keyIndex is empty and should be removed."""
@@ -234,18 +226,33 @@ class MVCCStore:
             self.size -= len(kv.key) + len(kv.value)
 
     def hash_kv(self, rev: int = 0) -> int:
-        """Maintenance/HashKV analog (mvcc/hash.go): order-independent-free
-        digest of live revision data up to rev."""
-        import zlib
+        """Maintenance/HashKV analog (mvcc/hash.go): order-independent
+        digest of revision data up to rev, folded with the canonical
+        mixing kernel shared with the device apply plane
+        (device_mvcc/scheme.py) — the corruption checker, the chaos
+        report and the device plane's equivalence checks all compare
+        digests built from the same fold."""
+        from etcd_tpu.device_mvcc import scheme
 
         at = rev if rev > 0 else self.current_rev
-        h = 0
-        for (main, sub), (kv, tomb) in sorted(self.revs.items()):
+        s = 0
+        for (main, sub), (kv, tomb) in self.revs.items():
             if main > at:
                 continue
-            rec = b"%d/%d/%s/%s/%d" % (main, sub, kv.key, kv.value, tomb)
-            h = zlib.crc32(rec, h)
-        return h
+            s = scheme.u32(s + scheme.u32(scheme.history_record_mix(
+                main, sub, scheme.u32(scheme.bytes32(kv.key)),
+                scheme.u32(scheme.bytes32(kv.value)), tomb,
+            )))
+        return scheme.u32(s * scheme.MIX_C + at * scheme.MIX_D + scheme.MIX_A)
+
+    def hash_kv_latest(self, nkeys: int) -> int:
+        """The canonical latest-record digest over the device key space —
+        bit-equal to the device plane's ``kv_digest`` lane for a store
+        that applied the same committed words (scheme.store_latest_digest;
+        the differential-fuzz parity gate)."""
+        from etcd_tpu.device_mvcc import scheme
+
+        return scheme.store_latest_digest(self, nkeys)
 
     # -- snapshot (Maintenance.Snapshot / etcdutl analog) --------------------
     def to_snapshot(self) -> dict:
@@ -279,6 +286,251 @@ class MVCCStore:
         return st
 
 
+class DeviceBackedStore:
+    """MVCCStore-shaped facade over one lane of the device-resident apply
+    plane (etcd_tpu/device_mvcc) — the \"thin host facade over device
+    state\" the apply-plane refactor calls for: the authoritative revision
+    store lives on device as ``[keys, C]`` tensors; this class only
+    encodes ops into int32 words, dispatches one jitted masked apply, and
+    materializes KeyValue/Event objects from lane readbacks.
+
+    Contract differences from the host store (all inherent to the
+    latest-record layout, and documented rather than papered over):
+
+      * keys/values must be canonical (scheme.key_bytes/encode_value);
+        anything else raises ValueError before touching the device.
+      * lease ids ride a 4-bit word field (0..15).
+      * historical reads: a matching key whose mod_revision is above the
+        requested rev raises ErrCompacted — the plane's effective per-key
+        compaction floor is its latest record (see device_mvcc.apply
+        .read_at). Reads at the current revision are always exact.
+      * ``revs`` exposes the latest record per key (revision-coalesced
+        history): watcher catch-up replays coalesced deltas, the same
+        delivery contract as the device watch scan.
+      * ``size`` counts live latest records (quota/status accounting),
+        not retained history bytes.
+    """
+
+    def __init__(self, plane, lane: int = 0):
+        from etcd_tpu.device_mvcc import scheme
+
+        self.plane = plane
+        self.lane = lane
+        self._scheme = scheme
+
+    # -- cursors -------------------------------------------------------------
+    @property
+    def current_rev(self) -> int:
+        return self.plane.current_rev(self.lane)
+
+    @property
+    def compact_rev(self) -> int:
+        return self.plane.compact_rev(self.lane)
+
+    @property
+    def size(self) -> int:
+        sc = self._scheme
+        n = 0
+        for kid, r in self.plane.records(self.lane).items():
+            n += len(sc.key_bytes(kid))
+            if not r["tomb"]:
+                n += len(sc.encode_value(r["vword"]))
+        return n
+
+    # -- record materialization ---------------------------------------------
+    def _kv(self, kid: int, r: dict) -> KeyValue:
+        sc = self._scheme
+        if r["tomb"]:
+            return KeyValue(sc.key_bytes(kid), b"", 0, r["mod"], 0)
+        return KeyValue(sc.key_bytes(kid), sc.encode_value(r["vword"]),
+                        r["create"], r["mod"], r["version"], r["lease"])
+
+    def _rev_keyed(self) -> dict:
+        """Latest record per key, keyed (mod, sub) — records sharing one
+        main (a multi-op txn, or one delete-range over several keys) get
+        distinct subs in key-id order, so none collide. The device never
+        materializes subs; key-id order is the one deterministic
+        assignment both readers of this view (watcher catch-up,
+        snapshot materialization) can agree on."""
+        records = self.plane.records(self.lane)
+        by_main: dict[int, int] = {}
+        out = {}
+        for kid in sorted(records):
+            r = records[kid]
+            sub = by_main.get(r["mod"], 0)
+            by_main[r["mod"]] = sub + 1
+            out[(r["mod"], sub)] = (self._kv(kid, r), r["tomb"])
+        return out
+
+    @property
+    def revs(self) -> dict:
+        """The coalesced history view WatchableStore's catch-up path
+        reads (latest record per key; see _rev_keyed)."""
+        return self._rev_keyed()
+
+    def _key_range(self, key: bytes, range_end: bytes | None) -> tuple[int, int]:
+        sc = self._scheme
+        lo = sc.key_id(key)
+        if range_end is None:
+            return lo, lo + 1
+        if range_end == b"\x00":
+            return lo, self.plane.kvspec.keys
+        return lo, sc.key_id(range_end)
+
+    # -- txn / read API (MVCCStore surface) ----------------------------------
+    def write_txn(self) -> "DeviceWriteTxn":
+        return DeviceWriteTxn(self)
+
+    def range(self, key: bytes, range_end: bytes | None = None, rev: int = 0,
+              limit: int = 0, count_only: bool = False):
+        cur = self.current_rev
+        at = rev if rev > 0 else cur
+        if at > cur:
+            raise ErrFutureRev(at)
+        if at < self.compact_rev:
+            raise ErrCompacted(at)
+        lo, hi = self._key_range(key, range_end)
+        kvs: list[KeyValue] = []
+        count = 0
+        records = self.plane.records(self.lane)
+        for kid in sorted(records):
+            if not lo <= kid < hi:
+                continue
+            r = records[kid]
+            if r["mod"] > at:
+                # latest-record store: this key's state at `at` was
+                # compacted-to-latest by construction — refuse rather
+                # than serve the newer record as history
+                raise ErrCompacted(at)
+            if r["tomb"]:
+                continue
+            count += 1
+            if count_only or (limit and len(kvs) >= limit):
+                continue
+            kvs.append(self._kv(kid, r))
+        return kvs, count, at
+
+    def compact(self, rev: int) -> None:
+        if rev <= self.compact_rev:
+            raise ErrCompacted(rev)
+        if rev > self.current_rev:
+            raise ErrFutureRev(rev)
+        self.plane.apply_word_lane(self.lane, self._scheme.encode_compact(rev))
+
+    # -- digests -------------------------------------------------------------
+    def hash_kv(self, rev: int = 0) -> int:
+        """The canonical device digest (scheme.latest_digest) — the same
+        int32 the differential-fuzz gate compares; rev is accepted for
+        interface parity but only the current revision is served."""
+        if rev > self.current_rev:
+            raise ErrFutureRev(rev)
+        return self.plane.digest(self.lane)
+
+    # -- snapshots (materialized through the host store) ---------------------
+    def _materialize(self) -> MVCCStore:
+        """Latest records as a single-generation host MVCCStore (the
+        snapshot donor form; history below the latest record does not
+        exist on device, so none is invented)."""
+        st = MVCCStore()
+        st.current_rev = self.current_rev
+        st.compact_rev = self.compact_rev
+        for (mod, sub), (kv, tomb) in self._rev_keyed().items():
+            ki = KeyIndex(kv.key)
+            if tomb:
+                ki.tombstone(Revision(mod, sub))
+            else:
+                ki.put(Revision(mod, sub))
+            st.index[kv.key] = ki
+            st.revs[(mod, sub)] = (kv, tomb)
+            st.size += len(kv.key) + len(kv.value)
+        st._sorted_dirty = True
+        return st
+
+    def to_snapshot(self) -> dict:
+        return self._materialize().to_snapshot()
+
+    def load_snapshot(self, snap: dict) -> None:
+        """Install a snapshot into the device lane (the applySnapshot
+        path for the device plane)."""
+        sc = self._scheme
+        host = MVCCStore.from_snapshot(snap)
+        records = {}
+        for (kid, mod, create, version, vword, lease, tomb) in (
+                sc.store_latest_records(host, self.plane.kvspec.keys)):
+            records[kid] = {"mod": mod, "create": create, "version": version,
+                           "vword": vword, "lease": lease, "tomb": tomb}
+        self.plane.load_lane(self.lane, records, host.current_rev,
+                             host.compact_rev)
+
+
+class DeviceWriteTxn:
+    """WriteTxn facade over the device lane: ops dispatch eagerly,
+    word-by-word, with the CONT bit joining them into one device txn
+    (same revision main) — so intra-txn read-your-writes falls out of
+    reading the live device state, exactly like the host txn's buffer
+    visibility. Events are built from pre/post lane readbacks."""
+
+    def __init__(self, store: DeviceBackedStore):
+        self.s = store
+        self.events: list[tuple[str, KeyValue, KeyValue | None]] = []
+        self._started = False
+        self.main = store.current_rev + 1
+
+    def _prev(self, kid: int) -> KeyValue | None:
+        r = self.s.plane.records(self.s.lane).get(kid)
+        if r is None or r["tomb"]:
+            return None
+        return self.s._kv(kid, r)
+
+    def range(self, key: bytes, range_end: bytes | None = None,
+              limit: int = 0, count_only: bool = False):
+        # eager application means the live lane IS the txn's view
+        return self.s.range(key, range_end, 0, limit, count_only)
+
+    def put(self, key: bytes, value: bytes, lease: int = 0) -> int:
+        sc = self.s._scheme
+        kid = sc.key_id(key)
+        if kid >= self.s.plane.kvspec.keys:
+            # validate BEFORE dispatch: the device op would stamp a
+            # phantom revision with no key slot to land on
+            raise ValueError(
+                f"key {key!r} outside the device key space "
+                f"(keys={self.s.plane.kvspec.keys})"
+            )
+        word = sc.encode_put(kid, sc.decode_value(value), lease,
+                             cont=self._started)
+        prev = self._prev(kid)
+        self.s.plane.apply_word_lane(self.s.lane, word)
+        self._started = True
+        r = self.s.plane.records(self.s.lane)[kid]
+        kv = self.s._kv(kid, r)
+        self.events.append(("put", kv, prev))
+        return kv.mod_revision
+
+    def delete_range(self, key: bytes, range_end: bytes | None = None) -> int:
+        sc = self.s._scheme
+        lo, hi = self.s._key_range(key, range_end)
+        pre = {
+            kid: r for kid, r in self.s.plane.records(self.s.lane).items()
+            if lo <= kid < hi and not r["tomb"]
+        }
+        if not pre:
+            return 0
+        word = sc.encode_delete_range(lo, min(hi, (1 << sc.HI_BITS) - 1),
+                                      cont=self._started)
+        self.s.plane.apply_word_lane(self.s.lane, word)
+        self._started = True
+        post = self.s.plane.records(self.s.lane)
+        for kid in sorted(pre):
+            kv = self.s._kv(kid, post[kid])
+            self.events.append(("delete", kv, self.s._kv(kid, pre[kid])))
+        return len(pre)
+
+    def end(self) -> int:
+        # the device bumped current_rev per writing word already
+        return self.s.current_rev
+
+
 class WriteTxn:
     """One applied entry's write transaction: all ops share revision main =
     current_rev + 1, distinct subs (kvstore_txn.go:127-240); End() bumps
@@ -307,18 +559,25 @@ class WriteTxn:
             s.index[key] = ki
             s._sorted_dirty = True
         # visibility at self.main: ops in this txn see earlier ops of the
-        # same txn (intra-txn read-your-writes, kvstore_txn.go tx buffer)
-        prev = ki.created_version(self.main)
-        if prev is None:
-            create, version = rev, 1
-        else:
-            create, version = prev[0], prev[1] + 1
+        # same txn (intra-txn read-your-writes, kvstore_txn.go tx buffer).
+        # create/version come from the previous RECORD, not an index walk:
+        # the reference stores them in the KeyValue and restores the
+        # keyIndex generation's (created, ver) from it (kvstore.go
+        # restore + key_index.go generation{created, ver}), so they
+        # survive compaction — an index-walk derivation regressed both
+        # once compaction dropped the generation's older revisions (and
+        # diverged from the device apply plane, whose latest-record store
+        # is exactly the reference's record-carried semantics).
         prev_kv = None
         pr = ki.get(self.main)
         if pr is not None:
             prev_kv = s.revs[(pr.main, pr.sub)][0]
+        if prev_kv is None:
+            create, version = rev.main, 1
+        else:
+            create, version = prev_kv.create_revision, prev_kv.version + 1
         ki.put(rev)
-        kv = KeyValue(key, value, create.main, rev.main, version, lease)
+        kv = KeyValue(key, value, create, rev.main, version, lease)
         s.revs[(rev.main, rev.sub)] = (kv, False)
         s.size += len(key) + len(value)
         self.events.append(("put", kv, prev_kv))

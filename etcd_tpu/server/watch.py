@@ -25,6 +25,48 @@ class Event:
     prev_kv: KeyValue | None = None
 
 
+def events_from_delta(delta, c: int) -> list:
+    """Fan one group's device-extracted watch delta
+    (etcd_tpu/device_mvcc/apply.py:extract_deltas) out as
+    ``(type, KeyValue, prev_kv)`` tuples — the exact shape a host write
+    txn's ``events`` list has, so ``WatchableStore.notify`` consumes the
+    return value directly:
+
+        ws.notify(events_from_delta(delta, c))
+
+    Device deltas are revision-coalesced (one event per key per round,
+    carrying the newest record; prev_kv is always None — history below
+    the latest record does not exist on device); see the apply-plane
+    README section for the delivery contract."""
+    import numpy as np
+
+    from etcd_tpu.device_mvcc import scheme
+
+    mask = np.asarray(delta.mask[..., c])
+    if not mask.any():
+        return []
+    tomb = np.asarray(delta.tomb[..., c])
+    mod = np.asarray(delta.mod[..., c])
+    create = np.asarray(delta.create[..., c])
+    version = np.asarray(delta.version[..., c])
+    vword = np.asarray(delta.vword[..., c])
+    lease = np.asarray(delta.lease[..., c])
+    out = []
+    for kid in np.nonzero(mask)[0]:
+        kid = int(kid)
+        if tomb[kid]:
+            kv = KeyValue(scheme.key_bytes(kid), b"", 0, int(mod[kid]), 0)
+            out.append(("delete", kv, None))
+        else:
+            kv = KeyValue(
+                scheme.key_bytes(kid), scheme.encode_value(int(vword[kid])),
+                int(create[kid]), int(mod[kid]), int(version[kid]),
+                int(lease[kid]),
+            )
+            out.append(("put", kv, None))
+    return out
+
+
 @dataclasses.dataclass
 class Watcher:
     id: int

@@ -1,0 +1,48 @@
+"""Env-knob parsing with the exit-2-before-device-work contract.
+
+The scale drivers (bench.py, chaos_run.py) validate every env knob up
+front and exit 2 with a pointed one-line message on a bad value, before
+any device work — the contract the knob exit-code tests
+(tests/test_recovery_member.py, tests/test_device_mvcc.py) enforce.
+This module is the single copy of that pattern; drivers bind their
+program name via functools.partial.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def knob_error(prog: str, msg: str) -> "NoReturn":  # noqa: F821 — py3.9
+    print(f"{prog}: {msg}", file=sys.stderr)
+    raise SystemExit(2)
+
+
+def env_float(prog: str, name: str, default: str,
+              lo: float | None = None, hi: float | None = None) -> float:
+    raw = os.environ.get(name, default)
+    try:
+        v = float(raw)
+    except ValueError:
+        knob_error(prog, f"{name}={raw!r} is not a number")
+    if v != v:  # NaN compares False against any range bound
+        knob_error(prog, f"{name}={raw!r} is not a number")
+    if lo is not None and v < lo or hi is not None and v > hi:
+        span = (f"[{lo}, {hi}]" if hi is not None else f">= {lo}")
+        knob_error(prog, f"{name}={raw} outside {span}")
+    return v
+
+
+def env_int(prog: str, name: str, default: str | None,
+            lo: int | None = None, hi: int | None = None) -> int | None:
+    raw = os.environ.get(name, default)
+    if raw is None:
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        knob_error(prog, f"{name}={raw!r} is not an integer")
+    if (lo is not None and v < lo) or (hi is not None and v > hi):
+        span = (f"[{lo}, {hi}]" if hi is not None else f">= {lo}")
+        knob_error(prog, f"{name}={raw} outside {span}")
+    return v

@@ -13,7 +13,11 @@
 # crash recovery exercises the raft state machines this tier guards —
 # as does the membership-chaos tier's fast configuration
 # (tests/test_recovery_member.py: <=16 groups, conf-change injection +
-# config-aware checkers; the 4096-group shape stays behind -m slow).
+# config-aware checkers; the 4096-group shape stays behind -m slow), and
+# the device-MVCC apply plane's fast tier (tests/test_device_mvcc.py:
+# differential fuzz at <=128 groups, engine/kvserver integration; the
+# 4096-group acceptance fuzz stays behind -m slow) — the apply plane
+# consumes the frontier these state machines produce.
 cd "$(dirname "$0")"
 exec python -m pytest -q -m 'not slow' \
   tests/test_datadriven_quorum.py \
@@ -31,4 +35,5 @@ exec python -m pytest -q -m 'not slow' \
   tests/test_sparse_held.py \
   tests/test_recovery_crash.py \
   tests/test_recovery_member.py \
+  tests/test_device_mvcc.py \
   "$@"

@@ -68,7 +68,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: full-scale tiers excluded from the tier-1 run "
-        "(-m 'not slow'); e.g. the 262k-group crash-chaos run")
+        "(-m 'not slow'); e.g. the 262k-group crash-chaos run and the "
+        "4096-group device-MVCC acceptance fuzz (no new marker needed "
+        "for the apply plane — its scale shapes ride this one)")
 
 
 def bootstrap_cert_cn_auth(call):

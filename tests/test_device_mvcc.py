@@ -1,0 +1,590 @@
+"""Device-resident MVCC apply plane: differential fuzz + integration.
+
+The equivalence contract (ISSUE 7 / ROADMAP "Device-resident apply
+plane"): the device revision store (etcd_tpu/device_mvcc) applied over a
+committed word stream must be indistinguishable — under the shared
+canonical digest, the revision cursors, the per-key latest records and
+the compaction-boundary errors — from the host ``MVCCStore`` replaying
+the same schedule.  The fuzz harness (device_mvcc/fuzz.py) runs each
+GROUP of the batched store as its own randomized schedule, so one device
+dispatch checks hundreds of schedules; the 4096-group acceptance shape
+rides behind the ``slow`` marker (tier-1 stays fast), with the fast tier
+covering the same code paths at small shapes.
+
+Also covered here: the engine integration (build_kv_round consuming the
+apply frontier; one trace serving host-apply and device-apply via the
+do_apply operand), the kvserver device plane (DeviceBackedStore facade:
+puts/txns/compaction/watch/hash through a real EtcdCluster), the watch
+delta fan-out, and the APPLY_* knob validation exit codes of bench.py
+and chaos_run.py.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from etcd_tpu.device_mvcc import (
+    KVSpec,
+    apply_words,
+    init_kv,
+    kv_digest,
+    read_at,
+    scheme,
+)
+from etcd_tpu.device_mvcc.apply import _record_mix
+from etcd_tpu.device_mvcc.fuzz import differential_run, gen_schedules, host_replay
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- codec
+
+
+def test_word_codec_roundtrip():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        kid = int(rng.integers(scheme.MAX_KEYS + 1))
+        val = int(rng.integers(scheme.MAX_VAL + 1))
+        lease = int(rng.integers(scheme.MAX_LEASE + 1))
+        w = scheme.encode_put(kid, val, lease, cont=bool(rng.integers(2)))
+        d = scheme.decode(w)
+        assert (d["kind"], d["key"], d["val"], d["lease"]) == (
+            scheme.KIND_PUT, kid, val, lease)
+        lo = int(rng.integers(scheme.MAX_KEYS + 1))
+        hi = int(rng.integers(lo, (1 << scheme.HI_BITS)))
+        d = scheme.decode(scheme.encode_delete_range(lo, hi))
+        assert (d["kind"], d["lo"], d["hi"]) == (scheme.KIND_DELETE, lo, hi)
+        rev = int(rng.integers(scheme.MAX_COMPACT_REV + 1))
+        d = scheme.decode(scheme.encode_compact(rev))
+        assert (d["kind"], d["rev"]) == (scheme.KIND_COMPACT, rev)
+    # words stay positive int32 (and off the int16 wire by design)
+    assert scheme.encode_put(scheme.MAX_KEYS, scheme.MAX_VAL,
+                             scheme.MAX_LEASE) < 2 ** 31
+    with pytest.raises(ValueError):
+        scheme.encode_put(scheme.MAX_KEYS + 1, 0)
+    with pytest.raises(ValueError):
+        scheme.encode_compact(scheme.MAX_COMPACT_REV + 1)
+
+
+def test_canonical_key_value_codecs():
+    for kid in (0, 7, 511):
+        assert scheme.key_id(scheme.key_bytes(kid)) == kid
+    for v in (0, 1, 4095):
+        assert scheme.decode_value(scheme.encode_value(v)) == v
+    with pytest.raises(ValueError):
+        scheme.key_id(b"not-canonical")
+    with pytest.raises(ValueError):
+        scheme.decode_value(b"zzz")
+
+
+def test_record_mix_cross_check():
+    """The python fold (scheme.record_mix, the host half) and the jnp
+    fold (apply._record_mix, the device half) must be bit-congruent —
+    this is what makes 'the same digest' literal."""
+    rng = np.random.default_rng(1)
+    n = 64
+    key = rng.integers(0, 512, n).astype(np.int32)
+    mod = rng.integers(0, 1 << 24, n).astype(np.int32)
+    create = rng.integers(0, 1 << 24, n).astype(np.int32)
+    version = rng.integers(0, 1 << 16, n).astype(np.int32)
+    vword = rng.integers(0, 4096, n).astype(np.int32)
+    lease = rng.integers(0, 16, n).astype(np.int32)
+    tomb = rng.integers(0, 2, n).astype(bool)
+    dev = np.asarray(_record_mix(
+        jnp.asarray(key), jnp.asarray(mod), jnp.asarray(create),
+        jnp.asarray(version), jnp.asarray(vword), jnp.asarray(lease),
+        jnp.asarray(tomb),
+    ))
+    for i in range(n):
+        assert int(dev[i]) == scheme.record_mix(
+            int(key[i]), int(mod[i]), int(create[i]), int(version[i]),
+            int(vword[i]), int(lease[i]), bool(tomb[i]))
+
+
+# ------------------------------------------------------- differential fuzz
+
+
+def test_differential_fuzz_fast():
+    """128 independent randomized schedules (puts incl. multi-op CONT
+    txns, point/interval/to-end deletes, valid + boundary-violating
+    compactions) — full parity on digest, cursors, error lanes and
+    per-key records."""
+    rep = differential_run(KVSpec(keys=16), groups=128, ops=60, seed=2)
+    assert rep["parity_ok"], rep
+
+
+def test_differential_fuzz_wide_keyspace():
+    rep = differential_run(KVSpec(keys=64), groups=32, ops=80, seed=3)
+    assert rep["parity_ok"], rep
+
+
+def test_fuzz_exercises_all_op_classes():
+    """The generator must actually cover tombstones, compactions and
+    multi-op txns, or the parity gates above prove less than claimed."""
+    kvspec = KVSpec(keys=16)
+    words = gen_schedules(kvspec, 64, 60, seed=2)
+    kinds = words & 3
+    assert (kinds == scheme.KIND_PUT).any()
+    assert (kinds == scheme.KIND_DELETE).any()
+    assert (kinds == scheme.KIND_COMPACT).any()
+    assert ((words & scheme.CONT_BIT) != 0).any()
+    # and the error lanes actually fire somewhere in the batch
+    st = apply_words(kvspec, init_kv(kvspec, 64), words)
+    assert int(np.asarray(st.err_compacted).sum()) > 0
+    assert int(np.asarray(st.err_future).sum()) > 0
+    # tombstones survive until compaction in at least some group
+    assert bool(np.asarray(st.tomb).any())
+
+
+@pytest.mark.slow
+def test_differential_fuzz_acceptance_4096():
+    """The acceptance-scale shape: >=100 randomized schedules at >=4096
+    groups (every group IS a distinct schedule; all 4096 host-replayed),
+    compaction + tombstones included — hash_kv parity via the shared
+    canonical digest."""
+    rep = differential_run(KVSpec(keys=64), groups=4096, ops=120, seed=8)
+    assert rep["checked"] == 4096
+    assert rep["parity_ok"], rep
+
+
+# ----------------------------------------------------- targeted semantics
+
+
+def _one_lane(kvspec, words):
+    st = apply_words(kvspec, init_kv(kvspec, 1),
+                     np.asarray(words, np.int32)[:, None])
+    return jax.tree.map(np.asarray, st)
+
+
+def test_multi_op_txn_revision_semantics():
+    """CONT words share one revision main (WriteTxn semantics): two puts
+    in one txn bump version twice at one revision; delete-then-put in a
+    txn opens a fresh generation at the same main."""
+    kvspec = KVSpec(keys=8)
+    st = _one_lane(kvspec, [
+        scheme.encode_put(1, 10),                       # rev 2
+        scheme.encode_put(1, 11),                       # rev 3
+        scheme.encode_put(1, 12, cont=False),           # rev 4 op 1
+        scheme.encode_put(1, 13, cont=True),            # rev 4 op 2
+        scheme.encode_delete_range(1, 2, cont=False),   # rev 5 op 1
+        scheme.encode_put(1, 14, cont=True),            # rev 5 op 2
+    ])
+    assert int(st.current_rev[0]) == 5
+    assert int(st.mod[1, 0]) == 5
+    assert int(st.create[1, 0]) == 5      # fresh generation post-tombstone
+    assert int(st.version[1, 0]) == 1
+    assert not bool(st.tomb[1, 0])
+    # the same schedule through the host store agrees record-for-record
+    store, _, _ = host_replay(kvspec, np.asarray([
+        scheme.encode_put(1, 10), scheme.encode_put(1, 11),
+        scheme.encode_put(1, 12), scheme.encode_put(1, 13, cont=True),
+        scheme.encode_delete_range(1, 2), scheme.encode_put(1, 14, cont=True),
+    ], np.int32))
+    assert store.current_rev == 5
+    kvs, _, _ = store.range(scheme.key_bytes(1))
+    assert (kvs[0].mod_revision, kvs[0].create_revision, kvs[0].version) == (
+        5, 5, 1)
+
+
+def test_cont_after_compact_opens_fresh_txn():
+    """A compact closes the open txn (txn_main lane resets), so a CONT
+    word right after it — or as the first word ever — opens a fresh txn
+    instead of binding a stale/zero main (review finding: the guard
+    lives in apply_word, not in every word producer)."""
+    kvspec = KVSpec(keys=8)
+    words = np.asarray([
+        scheme.encode_put(0, 1),               # rev 2
+        scheme.encode_compact(2),              # closes the txn
+        scheme.encode_put(1, 2, cont=True),    # must open rev 3, not rev 2
+    ], np.int32)
+    st = _one_lane(kvspec, words)
+    assert int(st.current_rev[0]) == 3
+    assert int(st.mod[1, 0]) == 3
+    store, _, _ = host_replay(kvspec, words)
+    assert scheme.store_latest_digest(store, 8) == int(
+        np.asarray(kv_digest(kvspec, apply_words(
+            kvspec, init_kv(kvspec, 1), words[:, None])))[0])
+    # first-ever word carrying CONT: no open txn -> fresh main, and the
+    # revision cursor never regresses below the boot value
+    st = _one_lane(kvspec, [scheme.encode_put(0, 1, cont=True)])
+    assert int(st.current_rev[0]) == 2
+    assert int(st.mod[0, 0]) == 2
+
+
+def test_device_txn_rejects_out_of_space_key():
+    """A canonical key beyond the configured key space must fail BEFORE
+    dispatch — no phantom revision on the device lane (review finding)."""
+    from etcd_tpu.device_mvcc import DevicePlane
+    from etcd_tpu.server.mvcc import DeviceBackedStore
+
+    store = DeviceBackedStore(DevicePlane(KVSpec(keys=8)))
+    txn = store.write_txn()
+    with pytest.raises(ValueError, match="key space"):
+        txn.put(scheme.key_bytes(20), scheme.encode_value(1))
+    assert store.current_rev == 1          # nothing stamped
+    assert store.plane.records(0) == {}
+
+
+def test_device_snapshot_preserves_multi_key_revisions():
+    """Records sharing one revision main (multi-op txn, multi-key
+    delete-range) must all survive to_snapshot/restore — the (mod, sub)
+    keying collision of the first facade cut (review finding)."""
+    from etcd_tpu.device_mvcc import DevicePlane
+    from etcd_tpu.server.mvcc import DeviceBackedStore, MVCCStore
+
+    store = DeviceBackedStore(DevicePlane(KVSpec(keys=8)))
+    txn = store.write_txn()
+    txn.put(scheme.key_bytes(2), scheme.encode_value(5))
+    txn.put(scheme.key_bytes(3), scheme.encode_value(6))
+    txn.end()
+    assert len(store.revs) == 2            # distinct (mod, sub) keys
+    host = MVCCStore.from_snapshot(store.to_snapshot())
+    kvs, _, _ = host.range(scheme.key_bytes(2))
+    assert kvs[0].key == scheme.key_bytes(2)
+    assert kvs[0].value == scheme.encode_value(5)
+    kvs, _, _ = host.range(scheme.key_bytes(3))
+    assert kvs[0].value == scheme.encode_value(6)
+
+
+def test_compaction_boundary_errors_and_gc():
+    kvspec = KVSpec(keys=8)
+    st = _one_lane(kvspec, [
+        scheme.encode_put(0, 1),            # rev 2
+        scheme.encode_put(1, 2),            # rev 3
+        scheme.encode_delete_range(0, 1),   # rev 4 (tombstone key 0)
+        scheme.encode_compact(9),           # > current -> ErrFutureRev
+        scheme.encode_compact(3),           # ok; tombstone at 4 survives
+        scheme.encode_compact(3),           # <= compact_rev -> ErrCompacted
+        scheme.encode_compact(4),           # ok; tombstoned key 0 drops
+    ])
+    assert int(st.err_future[0]) == 1
+    assert int(st.err_compacted[0]) == 1
+    assert int(st.compact_rev[0]) == 4
+    assert not bool(st.present[0, 0])      # whole key compacted away
+    assert bool(st.present[1, 0])          # live key keeps its record
+
+
+def test_read_at_window_semantics():
+    """read_at mirrors _check_rev's window errors; a key modified past
+    the requested rev is flagged unservable (the latest-only contract),
+    never served wrong."""
+    kvspec = KVSpec(keys=4)
+    words = [scheme.encode_put(0, 1),   # rev 2
+             scheme.encode_put(1, 2),   # rev 3
+             scheme.encode_put(0, 3),   # rev 4
+             scheme.encode_compact(3)]
+    st = apply_words(kvspec, init_kv(kvspec, 1),
+                     np.asarray(words, np.int32)[:, None])
+    vis, unserv, err_f, err_c = jax.tree.map(
+        np.asarray, read_at(kvspec, st, 3))
+    assert not err_f[0] and not err_c[0]
+    assert bool(vis[1, 0]) and not bool(vis[0, 0])
+    assert bool(unserv[0, 0])            # key 0 moved at rev 4
+    _, _, err_f, _ = jax.tree.map(np.asarray, read_at(kvspec, st, 99))
+    assert bool(err_f[0])
+    _, _, _, err_c = jax.tree.map(np.asarray, read_at(kvspec, st, 2))
+    assert bool(err_c[0])                # below the compaction floor
+    vis, unserv, err_f, err_c = jax.tree.map(
+        np.asarray, read_at(kvspec, st, 0))  # current: always exact
+    assert not err_f[0] and not err_c[0] and not unserv.any()
+    assert bool(vis[0, 0]) and bool(vis[1, 0])
+
+
+def test_watch_delta_extraction_parity():
+    """Per-round device deltas, fanned out through the host converter,
+    agree with a host watcher's view of the same schedule — up to the
+    documented revision-coalescing (one event per key per round carrying
+    the newest record)."""
+    from etcd_tpu.device_mvcc.apply import extract_deltas
+    from etcd_tpu.server.mvcc import MVCCStore
+    from etcd_tpu.server.watch import WatchableStore, events_from_delta
+
+    kvspec = KVSpec(keys=8)
+    roundwords = [
+        [scheme.encode_put(0, 1), scheme.encode_put(1, 2)],
+        [scheme.encode_put(0, 3), scheme.encode_delete_range(1, 2)],
+        [scheme.encode_put(2, 4, lease=3)],
+    ]
+    st = init_kv(kvspec, 1)
+    ws = WatchableStore(MVCCStore())
+    w = ws.watch(scheme.key_bytes(0), b"\x00")
+    # the documented fan-out bridge: device deltas feed a host watcher
+    # group via notify() directly
+    dev_ws = WatchableStore(MVCCStore())
+    dev_w = dev_ws.watch(scheme.key_bytes(0), b"\x00")
+    dev_last: dict[bytes, tuple] = {}
+    for words in roundwords:
+        floor = st.current_rev
+        st = apply_words(kvspec, st, np.asarray(words, np.int32)[:, None])
+        delta = extract_deltas(kvspec, floor, st)
+        evs = events_from_delta(delta, 0)
+        dev_ws.notify(evs)
+        for typ, kv, _prev in evs:
+            dev_last[kv.key] = (typ, kv.mod_revision, kv.value, kv.version,
+                                kv.lease)
+        for word in words:
+            op = scheme.decode(word)
+            txn = ws.kv.write_txn()
+            if op["kind"] == scheme.KIND_PUT:
+                txn.put(scheme.key_bytes(op["key"]),
+                        scheme.encode_value(op["val"]), op["lease"])
+            else:
+                txn.delete_range(scheme.key_bytes(op["lo"]))
+            txn.end()
+            ws.notify(txn.events)
+    host_last: dict[bytes, tuple] = {}
+    for ev in ws.take_events(w.id):
+        host_last[ev.kv.key] = (ev.type, ev.kv.mod_revision, ev.kv.value,
+                                ev.kv.version, ev.kv.lease)
+    assert dev_last == host_last
+    assert dev_last[scheme.key_bytes(1)][0] == "delete"
+    assert dev_last[scheme.key_bytes(2)][4] == 3  # lease rides the delta
+    # the notified watcher buffered every delta event with the right types
+    got = dev_ws.take_events(dev_w.id)
+    assert [(e.type, e.kv.key) for e in got] == [
+        ("put", scheme.key_bytes(0)), ("put", scheme.key_bytes(1)),
+        ("put", scheme.key_bytes(0)), ("delete", scheme.key_bytes(1)),
+        ("put", scheme.key_bytes(2)),
+    ]
+
+
+# ------------------------------------------------------ engine integration
+
+
+def test_engine_kv_round_frontier_and_modes():
+    """build_kv_round consumes the apply frontier: proposals become
+    applied revisions + watch deltas without leaving the device, the
+    digest matches a host replay of the same words, and do_apply=False
+    is an identity on the KV fleet (one trace, both apply modes)."""
+    from etcd_tpu.models.engine import (
+        _jitted_kv_round,
+        empty_inbox,
+        init_fleet,
+    )
+    from etcd_tpu.server.watch import events_from_delta
+    from etcd_tpu.types import Spec
+    from etcd_tpu.utils.config import RaftConfig
+
+    spec = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                     inbox_bound=4, coalesce_commit_refresh=True,
+                     wire_int16=False)
+    kvspec = KVSpec(keys=16)
+    C, M, E = 4, spec.M, spec.E
+    rnd = _jitted_kv_round(cfg, spec, kvspec, 0)
+    z2 = jnp.zeros((M, C), jnp.int32)
+    zp = jnp.zeros((M, E, C), jnp.int32)
+    no_hup = jnp.zeros((M, C), jnp.bool_)
+    no_tick = jnp.zeros((M, C), jnp.bool_)
+    keep = jnp.ones((M, M, C), jnp.bool_)
+    on = jnp.ones((C,), jnp.bool_)
+    state = init_fleet(spec, C, seed=0)
+    inbox = empty_inbox(spec, C)
+    kv = init_kv(kvspec, C)
+    state, inbox, kv, _ = rnd(state, inbox, kv, on, z2, zp, zp, z2,
+                              no_hup.at[0].set(True), no_tick, keep)
+    for _ in range(16):
+        state, inbox, kv, _ = rnd(state, inbox, kv, on, z2, zp, zp, z2,
+                                  no_hup, no_tick, keep)
+        if int((state.role == 3).sum()) == C:
+            break
+    assert int((state.role == 3).sum()) == C
+    words = [scheme.encode_put(r % 16, 100 + r, r % 4) for r in range(10)]
+    events = 0
+    for r in range(14):
+        pl = z2.at[0].set(1) if r < 10 else z2
+        pd = zp.at[0, 0].set(words[r]) if r < 10 else zp
+        state, inbox, kv, delta = rnd(state, inbox, kv, on, pl, pd, zp, z2,
+                                      no_hup, no_tick, keep)
+        events += len(events_from_delta(delta, 0))
+    assert events == 10                      # every write surfaced exactly once
+    assert int(np.asarray(kv.skipped).sum()) == 0
+    assert (np.asarray(kv.applied_idx) == np.asarray(state.applied[0])).all()
+    store, _, _ = host_replay(kvspec, np.asarray(words, np.int32))
+    want = scheme.store_latest_digest(store, 16)
+    assert all(int(d) == want for d in np.asarray(kv_digest(kvspec, kv)))
+    # host-apply mode: same trace, operand off -> KV fleet untouched
+    before = int(np.asarray(kv.current_rev[0]))
+    off = jnp.zeros((C,), jnp.bool_)
+    state, inbox, kv2, _ = rnd(
+        state, inbox, kv, off, z2.at[0].set(1),
+        zp.at[0, 0].set(scheme.encode_put(0, 9)), zp, z2, no_hup, no_tick,
+        keep,
+    )
+    assert int(np.asarray(kv2.current_rev[0])) == before
+    assert not bool(np.asarray(kv2.mod != kv.mod).any())
+
+
+def test_engine_kv_round_freezes_on_snapshot_install():
+    """A bound member that installs a peer snapshot keeps old ring bytes
+    under new cursors; the plane must detect the install (applied jump >
+    Spec.A — ring apply can never exceed A per round) and FREEZE the
+    lane (sticky desynced) instead of replaying stale words."""
+    from etcd_tpu.models.engine import (
+        _jitted_kv_round,
+        empty_inbox,
+        init_fleet,
+    )
+    from etcd_tpu.types import Spec
+    from etcd_tpu.utils.config import RaftConfig
+
+    spec = Spec(M=3, L=16, E=1, K=2, W=4, R=2, A=2)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                     inbox_bound=2, coalesce_commit_refresh=True,
+                     wire_int16=False)
+    kvspec = KVSpec(keys=16)
+    C, M, E = 1, spec.M, spec.E
+    rnd = _jitted_kv_round(cfg, spec, kvspec, 2)  # bind the SLOW follower
+    z2 = jnp.zeros((M, C), jnp.int32)
+    zp = jnp.zeros((M, E, C), jnp.int32)
+    no_hup = jnp.zeros((M, C), jnp.bool_)
+    no_tick = jnp.zeros((M, C), jnp.bool_)
+    full = jnp.ones((M, M, C), jnp.bool_)
+    cut2 = full.at[:, 2].set(False).at[2, :].set(False)
+    on = jnp.ones((C,), jnp.bool_)
+    state = init_fleet(spec, C, seed=0)
+    inbox = empty_inbox(spec, C)
+    kv = init_kv(kvspec, C)
+    state, inbox, kv, _ = rnd(state, inbox, kv, on, z2, zp, zp, z2,
+                              no_hup.at[0].set(True), no_tick, cut2)
+    for _ in range(12):
+        state, inbox, kv, _ = rnd(state, inbox, kv, on, z2, zp, zp, z2,
+                                  no_hup, no_tick, cut2)
+        if int(state.role[0, 0]) == 3:
+            break
+    # leader runs far ahead while member 2 is cut: the ring compacts and
+    # member 2 can only catch up via MsgSnap
+    for r in range(20):
+        pl = z2.at[0].set(1)
+        pd = zp.at[0, 0].set(scheme.encode_put(r % 16, r))
+        state, inbox, kv, _ = rnd(state, inbox, kv, on, pl, pd, zp, z2,
+                                  no_hup, no_tick, cut2)
+    assert int(state.snap_index[0, 0]) > 0     # leader compacted its ring
+    assert int(state.applied[2, 0]) == 0
+    all_tick = jnp.ones((M, C), jnp.bool_)
+    for r in range(40):                        # heal under ticks: the
+        # leader's heartbeat un-pauses the probe, walks member 2's
+        # next_idx below the compacted ring, and ships MsgSnap
+        state, inbox, kv, delta = rnd(state, inbox, kv, on, z2, zp, zp, z2,
+                                      no_hup, all_tick, full)
+        if bool(np.asarray(kv.desynced[0])):
+            break
+    assert int(np.asarray(state.applied[2, 0])) > spec.A  # install happened
+    assert bool(np.asarray(kv.desynced[0]))
+    # frozen, not corrupted: nothing was ever replayed into the lane
+    assert int(np.asarray(kv.current_rev[0])) == 1
+    assert not bool(np.asarray(kv.present).any())
+    assert not bool(np.asarray(delta.mask).any())
+
+
+def test_engine_kv_round_rejects_int16_wire():
+    from etcd_tpu.models.engine import build_kv_round
+    from etcd_tpu.types import Spec
+    from etcd_tpu.utils.config import RaftConfig
+
+    with pytest.raises(ValueError, match="int32 wire"):
+        build_kv_round(RaftConfig(wire_int16=True), Spec(), KVSpec(keys=8))
+
+
+# ----------------------------------------------------- kvserver facade
+
+
+def _mk_clusters():
+    from etcd_tpu.server.kvserver import EtcdCluster
+
+    dev = EtcdCluster(n_members=3, apply_plane="device", kv_keys=16)
+    host = EtcdCluster(n_members=3)
+    return dev, host
+
+
+def test_kvserver_device_plane_parity():
+    """The same client workload through a device-plane EtcdCluster and a
+    host-plane one: identical responses, identical canonical digests,
+    watch events flowing from the device lanes."""
+    from etcd_tpu.server.kvserver import Compare, Op
+
+    dev, host = _mk_clusters()
+    w = dev.watch(0, scheme.key_bytes(0), b"\x00")
+    for ec in (dev, host):
+        ec.put(scheme.key_bytes(1), scheme.encode_value(42))
+        ec.put(scheme.key_bytes(0), scheme.encode_value(7), lease=0)
+        ec.put(scheme.key_bytes(1), scheme.encode_value(43))
+        ec.delete_range(scheme.key_bytes(1))
+        ec.txn(
+            compare=[Compare(scheme.key_bytes(0), "version", "=", 1)],
+            success=[Op("put", scheme.key_bytes(2), scheme.encode_value(5)),
+                     Op("range", scheme.key_bytes(0))],
+        )
+        ec.compact(3)
+        ec.stabilize()
+    rd = dev.range(scheme.key_bytes(0), b"\x00")
+    rh = host.range(scheme.key_bytes(0), b"\x00")
+    assert [(kv.key, kv.value, kv.mod_revision, kv.create_revision,
+             kv.version) for kv in rd["kvs"]] == [
+        (kv.key, kv.value, kv.mod_revision, kv.create_revision, kv.version)
+        for kv in rh["kvs"]]
+    assert rd["rev"] == rh["rev"]
+    # one digest, both planes: device lanes vs host hash_kv_latest
+    want = host.members[0].store.kv.hash_kv_latest(16)
+    assert all(dev.hash_kv(m) == want for m in range(3))
+    dev.corruption_check()
+    evs = dev.watch_events(0, w.id)
+    assert [e.type for e in evs] == ["put", "put", "put", "delete", "put"]
+    # compaction-boundary errors surface as the host exceptions
+    from etcd_tpu.server.mvcc import ErrCompacted, ErrFutureRev
+
+    with pytest.raises(ErrCompacted):
+        dev.compact(2)
+    with pytest.raises(ErrFutureRev):
+        dev.compact(99)
+    with pytest.raises(ErrFutureRev):
+        dev.range(scheme.key_bytes(0), rev=99)
+
+
+def test_kvserver_device_plane_crash_recovery():
+    """A crashed device-plane member recovers through the peer-snapshot
+    path: its lane is reloaded from a donor and digests re-converge."""
+    from etcd_tpu.server.kvserver import EtcdCluster
+
+    dev = EtcdCluster(n_members=3, apply_plane="device", kv_keys=16)
+    for i in range(4):
+        dev.put(scheme.key_bytes(i % 3), scheme.encode_value(i))
+    dev.stabilize()
+    want = dev.hash_kv(0)
+    dev.crash_member(2)
+    dev.put(scheme.key_bytes(3), scheme.encode_value(9))
+    dev.restart_member_from_disk(2)
+    dev.stabilize()
+    assert not dev.members[2].crashed
+    assert dev.hash_kv(2) == dev.hash_kv(0) != want
+    dev.corruption_check()
+
+
+# ------------------------------------------------- knob validation (exit 2)
+
+
+@pytest.mark.parametrize("script,env_extra,needle", [
+    ("bench.py", {"APPLY_MODE": "bogus"}, "APPLY_MODE"),
+    ("bench.py", {"APPLY_MODE": "device", "APPLY_KEYS": "4096"},
+     "APPLY_KEYS"),
+    ("chaos_run.py", {"APPLY_KEYS": "-1"}, "APPLY_KEYS"),
+    ("chaos_run.py", {"APPLY_KEYS": "64", "APPLY_OPS": "0"}, "APPLY_OPS"),
+])
+def test_apply_knob_validation_exits_2(script, env_extra, needle):
+    """Bad APPLY_* values exit 2 with a pointed message before any device
+    work — the chaos_run knob-validation contract extended to the apply
+    plane."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **env_extra}
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, script)],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 2, (out.returncode, out.stdout, out.stderr)
+    assert needle in out.stderr
+    assert not out.stdout.strip()
