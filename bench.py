@@ -34,8 +34,26 @@ Knobs (validated up front; a bad value exits 2 before any device work):
 KV op words need the int32 wire, so the apply benchmark forces
 wire_int16=False (same rule as the membership chaos tier).
 
+Headline-bench knobs (all validated the same way, exit 2 on bad values):
+  BENCH_C / BENCH_ROUNDS / BENCH_REPS / BENCH_L / BENCH_W / BENCH_INBOX
+  BENCH_CHUNKS  fleet-chunk count; defaults CHUNK-FREE under the diet
+  BENCH_WIRE16  int16 wire (default 1 on accel)
+  BENCH_PACKED  packed resident state        (default 1 on accel, 0 CPU)
+  BENCH_CWIRE   compacted wire carry  (accel default when BENCH_INBOX>0)
+  BENCH_SPARSE  outbox out of the scan carry (accel default; needs
+                BENCH_DEFERRED; the diet trio is measured in
+                BENCH_r09.json — 2.49x lower bytes/group, chunk-free
+                1.14x over the 8-way chunked form at C=131072)
+  BENCH_DEFERRED / BENCH_CC  round-4/5 specialization A/B toggles
+The report carries the measured footprint: bytes/group from the actual
+leaf dtypes/shapes of the timed carries, the dense-form baseline and
+their ratio, plus jax.live_arrays() and peak-RSS readings.
+
 TPU rerun (when the accelerator tunnel returns):
   APPLY_MODE=both APPLY_C=262144 python bench.py > APPLY_TPU_r08.json
+  BENCH_C=1048576 BENCH_CHUNKS=1 python bench.py > BENCH_TPU_r09.json
+    (the diet's chunk-free 1M-group dispatch; BENCH_PACKED=0 restores
+    the 8-way chunked round-5 configuration for the A/B)
 """
 from __future__ import annotations
 
@@ -104,15 +122,17 @@ def _apply_bench(knobs: dict, platform: str, on_accel: bool) -> None:
     from etcd_tpu.types import Spec
     from etcd_tpu.utils.config import RaftConfig
 
+    from etcd_tpu.utils.knobs import env_int
+
     C = knobs["APPLY_C"] or (262_144 if on_accel else 8192)
     rounds = knobs["APPLY_ROUNDS"]
     keys = knobs["APPLY_KEYS"]
     kvspec = KVSpec(keys=keys)
     # bench geometry minus the int16 wire (KV words use bits 0-27)
     spec = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
-    chunks = int(os.environ.get(
-        "BENCH_CHUNKS", str(max(1, C // 131072)) if on_accel else "1"
-    ))
+    chunks = env_int(
+        "bench", "BENCH_CHUNKS",
+        str(max(1, C // 131072)) if on_accel else "1", lo=1)
     cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
                      inbox_bound=spec.M - 1, coalesce_commit_refresh=True,
                      wire_int16=False, fleet_chunks=chunks)
@@ -253,20 +273,27 @@ def main() -> None:
     from etcd_tpu.types import MSG_APP, MSG_APP_RESP, MSG_PROP, Spec
     from etcd_tpu.utils.config import RaftConfig
 
+    from etcd_tpu.utils.knobs import env_bool, env_int
+
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
     if apply_knobs["mode"] != "off":
         return _apply_bench(apply_knobs, platform, on_accel)
+    # Every BENCH_* knob is validated up front — a bad value exits 2 with
+    # a pointed message before any device work (utils/knobs.py, the same
+    # contract as the APPLY_*/CHAOS_* knobs; subprocess-tested in
+    # tests/test_device_mvcc.py).
+    #
     # clusters-minor layout: the huge C axis is last, so TPU (8,128) tiling
     # pads only the tiny member axes (<=1.6x) and C can grow toward the 1M
     # north-star without tile-padding blowup.
     # defaults match the measured configuration (SCALE_RESULTS.jsonl) so
     # a cold driver run reuses the persisted compile for the same shapes —
-    # the north-star 1M-group fleet, resident on one chip via 8-way fleet
-    # chunking + the int16 wire (434k group-rounds/s measured)
-    C = int(os.environ.get("BENCH_C", 1048576 if on_accel else 512))
-    inner = int(os.environ.get("BENCH_ROUNDS", 16 if on_accel else 8))
-    reps = int(os.environ.get("BENCH_REPS", 3 if on_accel else 2))
+    # the north-star 1M-group fleet, resident on one chip
+    C = env_int("bench", "BENCH_C", str(1048576 if on_accel else 512), lo=1)
+    inner = env_int("bench", "BENCH_ROUNDS",
+                    str(16 if on_accel else 8), lo=1)
+    reps = env_int("bench", "BENCH_REPS", str(3 if on_accel else 2), lo=1)
 
     # K=2 message slots: in the no-tick steady state each follower sees one
     # MsgApp per round (appends double as heartbeats, exactly the
@@ -275,27 +302,56 @@ def main() -> None:
     # BENCH_L trims the log ring for the 1M-group configuration: state is
     # ring-dominated (~3KB/cluster at L=32), and the steady state needs
     # only enough ring for the commit->apply pipeline (L > 2E + lag).
-    L = int(os.environ.get("BENCH_L", "16"))
-    W = int(os.environ.get("BENCH_W", "4"))
+    L = env_int("bench", "BENCH_L", "16", lo=2)
+    W = env_int("bench", "BENCH_W", "4", lo=1)
     spec = Spec(M=5, L=L, E=1, K=2, W=W, R=2, A=2)
     # inbox_bound=M-1: lossless in the one-proposal-per-round steady state
     # (leader sees M-1 acks, followers 1 append; see RaftConfig.inbox_bound
     # and tests/test_inbox_compaction.py), and cuts the dominant serial
     # message loop from M*K+3 to bound+3 steps per round.
-    bound = int(os.environ.get("BENCH_INBOX", str(spec.M - 1)))
-    # fleet chunking caps peak HLO-temp HBM (RaftConfig.fleet_chunks):
-    # default keeps each resident chunk at <= 131,072 clusters — the
-    # configuration the measured 1M run used (8 chunks)
-    chunks = int(os.environ.get(
-        "BENCH_CHUNKS", str(max(1, C // 131072)) if on_accel else "1"
-    ))
+    bound = env_int("bench", "BENCH_INBOX", str(spec.M - 1), lo=0)
     # wire_int16 halves the resident inbox (legal at bench horizons: every
     # wire value stays far below 32768 — see RaftConfig.wire_int16)
-    wire16 = os.environ.get("BENCH_WIRE16", "1" if on_accel else "0") != "0"
+    wire16 = env_bool("bench", "BENCH_WIRE16", "1" if on_accel else "0")
+    # The fleet memory diet (PROFILE.md round 6) is the default ACCEL
+    # configuration: bit/width-packed resident state, the compacted
+    # [bound, to, C] wire carry, and the dense outbox out of the scan
+    # carry. BENCH_PACKED=0 / BENCH_CWIRE=0 / BENCH_SPARSE=0 revert each
+    # piece for A/B runs (bit-identity proven in tests/test_packed_state
+    # .py and tests/test_sparse_outbox.py). On CPU the default stays
+    # dense: the diet trades elementwise shift/mask compute for resident
+    # bytes, which pays on an HBM-bandwidth-bound accelerator and
+    # measurably does NOT on the compute-bound host backend (~0.7x at
+    # C=8192 — BENCH_r09.json carries both readings); opt in explicitly
+    # to measure the footprint side on CPU.
+    from etcd_tpu.utils.knobs import knob_error
+
+    diet_default = "1" if on_accel else "0"
+    packed = env_bool("bench", "BENCH_PACKED", diet_default)
+    cwire = env_bool("bench", "BENCH_CWIRE",
+                     diet_default if bound > 0 else "0")
+    sparse = env_bool("bench", "BENCH_SPARSE", diet_default)
+    # an EXPLICIT diet knob that cannot take effect exits 2 like any
+    # other bad knob — silently measuring the dense form while the
+    # operator believes the diet was on would poison every A/B reading
+    if cwire and bound <= 0:
+        knob_error("bench", "BENCH_CWIRE=1 needs BENCH_INBOX > 0 "
+                   "(the compact carry stores the first `bound` slots)")
+    # fleet chunking caps peak HLO-temp HBM (RaftConfig.fleet_chunks).
+    # With the diet on, the default is CHUNK-FREE: the packed fleet +
+    # donated carry + sparse outbox fit the shapes that used to need the
+    # 8-way loop (the pre-diet default kept each resident chunk at
+    # <= 131,072 clusters). BENCH_PACKED=0 restores the chunked default
+    # for A/B against the round-5 configuration.
+    chunks = env_int(
+        "bench", "BENCH_CHUNKS",
+        "1" if (packed or not on_accel) else str(max(1, C // 131072)),
+        lo=1)
     cfg = RaftConfig(pre_vote=True, check_quorum=True,
                      max_inflight=min(4, W),
                      inbox_bound=bound, coalesce_commit_refresh=True,
-                     fleet_chunks=chunks, wire_int16=wire16)
+                     fleet_chunks=chunks, wire_int16=wire16,
+                     compact_wire=cwire and bound > 0)
     M, E = spec.M, spec.E
 
     devs = jax.devices()
@@ -304,7 +360,8 @@ def main() -> None:
     # device (clusters-minor) layout: [M, C] scalars, [M, E, C] proposals,
     # [M(from), M(to), C] keep-mask
     state = init_fleet(spec, C, seed=0, election_tick=cfg.election_tick)
-    inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
+    inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16,
+                        compact_bound=bound if cfg.compact_wire else 0)
     keep = jnp.ones((M, M, C), jnp.bool_)
     z2 = jnp.zeros((M, C), jnp.int32)
     zp = jnp.zeros((M, E, C), jnp.int32)
@@ -349,6 +406,15 @@ def main() -> None:
     # bit-exact equivalence on live steady traffic proven by
     # tests/test_local_steps.py). Election/settle and the metered
     # observability pass keep the full program.
+    deferred = env_bool("bench", "BENCH_DEFERRED", "1")
+    if sparse and not deferred and os.environ.get("BENCH_SPARSE") == "1":
+        # explicitly requested but structurally impossible (the sparse
+        # scan carry IS a deferred-emission form) — exit 2, don't
+        # silently measure the dense-carry program
+        from etcd_tpu.utils.knobs import knob_error
+
+        knob_error("bench", "BENCH_SPARSE=1 needs BENCH_DEFERRED=1 "
+                   "(the sparse scan carry is a deferred-emission form)")
     steady_cfg = _dc.replace(
         cfg,
         local_steps=("prop",),
@@ -357,28 +423,54 @@ def main() -> None:
         # record PendingWire intents; one post-scan merge materializes
         # them. Bit-exact on steady traffic (tests/test_deferred_emit.py).
         # BENCH_DEFERRED=0 reverts to immediate emission for A/B runs.
-        deferred_emit=os.environ.get("BENCH_DEFERRED", "1") != "0",
+        deferred_emit=deferred,
+        # ...and its completion (round 6): the dense outbox leaves the
+        # scan carry entirely (tests/test_sparse_outbox.py)
+        sparse_outbox=sparse and deferred,
+        # the resident fleet state between timed rounds is the packed
+        # storage form; pack/unpack bracket the timed scan below
+        packed_state=packed,
         # apply-scan specialization (PROFILE.md round 5): the steady
         # program commits only normal entries, so the conf-change apply
         # block (replayed on all Spec.A serial apply slots) drops at
         # trace time (tests/test_apply_specialization.py).
         # BENCH_CC=1 keeps it for A/B runs.
-        entry_classes=None if os.environ.get("BENCH_CC") == "1"
+        entry_classes=None if env_bool("bench", "BENCH_CC", "0")
         else ("normal",),
     )
     run = build_scan_rounds(steady_cfg, spec, mesh, rounds=inner)
     args = (prop_len, prop_data, zp, z2, no_hup, no_tick, keep)
 
+    # diet boundary: the settle phase ran the full program on the dense
+    # fleet; the timed scan carries the PACKED form (state shrinks ~2.4x,
+    # and with fleet_chunks the unpacked temps are chunk-local)
+    from etcd_tpu.models.state import pack_fleet, unpack_fleet, unpack_field
+
+    def fleet_commit(st):
+        # single-field probe: a full unpack between timed reps would
+        # materialize the whole dense fleet just to read one [M, C] row
+        return unpack_field(spec, st, "commit") if packed else st.commit
+
+    if packed:
+        state = pack_fleet(spec, state)
+        if mesh is not None:
+            state = shard_fleet(mesh, state)
+
     state, inbox = run(state, inbox, *args)  # compile + warm
-    jax.block_until_ready(state.commit)
-    commit0 = int(state.commit.min())
+    jax.block_until_ready(jax.tree.leaves(state)[0])
+    commit0 = int(fleet_commit(state).min())
 
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         state, inbox = run(state, inbox, *args)
-        jax.block_until_ready(state.commit)
+        jax.block_until_ready(jax.tree.leaves(state)[0])
         best = min(best, time.perf_counter() - t0)
+
+    # live-bytes accounting AFTER the timed reps: what is actually
+    # resident on device, next to the per-leaf-spec numbers reported
+    # below (donated carries mean no second fleet copy survives here)
+    live_bytes = sum(int(a.nbytes) for a in jax.live_arrays())
 
     # optional profiler capture of one timed run (the JAX-trace analog of
     # the reference's pprof/tracing endpoints, SURVEY §5)
@@ -388,7 +480,7 @@ def main() -> None:
         )
         with jax.profiler.trace(trace_dir):
             state, inbox = run(state, inbox, *args)
-            jax.block_until_ready(state.commit)
+            jax.block_until_ready(jax.tree.leaves(state)[0])
         print(f"# profiler trace written to {trace_dir}", file=sys.stderr)
 
     rounds_per_sec = inner / best
@@ -398,11 +490,16 @@ def main() -> None:
     # whole timed run (commit trails the proposal by the 2-round
     # append->ack pipeline, hence the small slack)
     total_rounds = inner * reps
-    min_commit = int(state.commit.min())
+    min_commit = int(fleet_commit(state).min())
     assert min_commit - commit0 >= total_rounds - 4, (
         f"commit advanced {min_commit - commit0} in {total_rounds} rounds; "
         "fleet is not in one-commit-per-round steady state"
     )
+    if packed:
+        # back to the dense form for the metered observability pass
+        state = unpack_fleet(spec, state)
+        if mesh is not None:
+            state = shard_fleet(mesh, state)
 
     # observability pass: a few metered rounds (fused counters; see
     # etcd_tpu/models/metrics.py) so the report carries election/lag stats
@@ -423,6 +520,38 @@ def main() -> None:
         )
     jax.block_until_ready(metrics.commits)
     rep = metrics_report(metrics, time.perf_counter() - t0, C, spec.M)
+
+    # -- resident-footprint accounting (the fleet memory diet's measured
+    # side): bytes/group from the ACTUAL leaf dtypes/shapes of the timed
+    # program's carries, the same accounting the regression budget in
+    # tests/test_packed_state.py guards, plus the device/live view
+    from etcd_tpu.models.engine import inbox_bytes_per_group
+    from etcd_tpu.models.state import state_bytes_per_group
+    import resource
+
+    st_b = state_bytes_per_group(spec, packed=packed)
+    wi_b = inbox_bytes_per_group(
+        spec, wire_int16=wire16,
+        compact_bound=bound if cfg.compact_wire else 0)
+    st_dense = state_bytes_per_group(spec)
+    wi_dense = inbox_bytes_per_group(spec, wire_int16=wire16)
+    footprint = {
+        "bytes_per_group_state": st_b,
+        "bytes_per_group_wire": wi_b,
+        "bytes_per_group_total": st_b + wi_b,
+        "bytes_per_group_dense_total": st_dense + wi_dense,
+        "bytes_ratio_vs_dense": round((st_dense + wi_dense)
+                                      / (st_b + wi_b), 2),
+        "fleet_bytes_resident": (st_b + wi_b) * C,
+        "live_bytes_after_timed_reps": live_bytes,
+        "rss_peak_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024, 1),
+        "packed_state": packed,
+        "compact_wire": bool(cfg.compact_wire),
+        "sparse_outbox": bool(steady_cfg.sparse_outbox),
+        "fleet_chunks": chunks,
+        "wire_int16": wire16,
+    }
 
     print(
         json.dumps(
@@ -453,6 +582,7 @@ def main() -> None:
                 ],
                 "commit_apply_lag_hist": rep["commit_apply_lag_hist"],
                 "msgs_dropped": rep["msgs_dropped"],
+                **footprint,
             }
         )
     )

@@ -571,6 +571,14 @@ def build_chaos_epoch(
     crash budget through targeted_crash_probs over the snapshot-install
     and membership-sensitive windows (boosts of 1 = plain Bernoulli).
     """
+    if cfg.packed_state or cfg.compact_wire:
+        # the fault machinery addresses the unpacked fleet and the dense
+        # [from, K, to] wire directly (crash wipes, held-buffer merges,
+        # snapshot-window masks); the diet forms are for the bench/scan
+        # paths, the epoch program keeps its memory headroom via donation
+        raise ValueError(
+            "chaos epochs need the unpacked fleet and the dense wire; "
+            "run with packed_state=False and compact_wire=False")
     round_fn = build_round(cfg, spec)
     M = spec.M
     # recovery bookkeeping (CrashState carry + config-aware checkers) is
@@ -854,7 +862,16 @@ def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
     operands). Donation of the fleet-sized carries (state/inbox/held) is
     accelerator-only: large-C runs that compile fine otherwise die at
     runtime allocation from double-buffering, while host runs don't need
-    the memory and keep maximum runtime portability."""
+    the memory and keep maximum runtime portability. Donating on CPU was
+    TRIED (round 6, with the engine/mesh donation work) and REVERTED:
+    empty_crash_state aliases state leaves by reference
+    (stable=state.last_index, prev_term=state.term), and the XLA CPU
+    runtime rejects a buffer that is both donated (inside state, arg 0)
+    and passed live (inside CrashState, arg 3) in one Execute —
+    `f(donate(a), a)` — which the member-tier heal handoff hits. The
+    TPU runtime tolerates the alias (the 262k–1M chaos evidence runs all
+    donated); donation safety for external callers is covered by
+    tests/test_donation.py against the engine/mesh builders."""
     if jax.default_backend() != "cpu":
         # held (arg 2) is None (no buffers) when the delay machinery is
         # compiled out — donating it is at best a no-op and has crashed

@@ -24,7 +24,14 @@ import jax
 import jax.numpy as jnp
 
 from etcd_tpu.models.raft import node_round
-from etcd_tpu.models.state import NodeState, init_node
+from etcd_tpu.models.state import (
+    NodeState,
+    PACK_TIMER_BITS,
+    init_node,
+    pack_fleet,
+    state_bytes_per_group,
+    unpack_fleet,
+)
 from etcd_tpu.ops.outbox import Outbox
 from etcd_tpu.types import (
     ENT_FIELDS,
@@ -115,7 +122,8 @@ def from_wire(m: Msg) -> Msg:
     )
 
 
-def empty_inbox(spec: Spec, C: int, wire_int16: bool = False) -> Msg:
+def empty_inbox(spec: Spec, C: int, wire_int16: bool = False,
+                compact_bound: int = 0) -> Msg:
     """Zeroed inbox, stored FLAT: leaves [from, K*to, C] (ent fields
     [from, K*to*E, C]).
 
@@ -125,19 +133,94 @@ def empty_inbox(spec: Spec, C: int, wire_int16: bool = False) -> Msg:
     keeps a medium dim next to C (<=1.6x pad); (b) delivery must not
     transpose, so the same tensor the senders write (axis 0 = from) is
     what receivers consume — build_round unflattens by free reshape and
-    maps receivers over the `to` axis."""
+    maps receivers over the `to` axis.
+
+    ``compact_bound`` > 0 (RaftConfig.compact_wire, pass cfg.inbox_bound):
+    the COMPACTED carry form instead — leaves [B(slot), to, C] (ent fields
+    [B, to*E, C]), the first B nonempty delivery slots per receiver. Same
+    minor-pair padding class ((to, C) instead of (K*to, C)); receivers are
+    mapped over axis 1."""
     from etcd_tpu.types import empty_msg
 
     m = empty_msg(spec)
+    B = min(compact_bound, spec.K * spec.M)
 
     def mk(name, x):
-        n = spec.K * spec.M * (spec.E if name in _ENT_FIELDS else 1)
+        e = spec.E if name in _ENT_FIELDS else 1
         dt = x.dtype
         if wire_int16 and dt == jnp.int32:
             dt = jnp.int16
-        return jnp.zeros((spec.M, n, C), dt)
+        if B:
+            return jnp.zeros((B, spec.M * e, C), dt)
+        return jnp.zeros((spec.M, spec.K * spec.M * e, C), dt)
 
     return Msg(**{k: mk(k, getattr(m, k)) for k in Msg.__dataclass_fields__})
+
+
+def inbox_bytes_per_group(spec: Spec, wire_int16: bool = False,
+                          compact_bound: int = 0) -> int:
+    """Resident wire bytes per group in the given storage form, from the
+    actual leaf dtypes/shapes (bench.py's accounting + the regression
+    budget in tests/test_packed_state.py).
+
+    Built EAGERLY at C=1 (a few hundred bytes), not under
+    jax.eval_shape: empty_inbox goes through the lru-cached
+    types.empty_msg, and an eval_shape call would poison that cache
+    with tracer leaves for this (spec, backend) key, crashing the next
+    eager inbox construction with an UnexpectedTracerError."""
+    sh = empty_inbox(spec, 1, wire_int16, compact_bound)
+    return sum(int(leaf.size) * leaf.dtype.itemsize
+               for leaf in jax.tree.leaves(sh))
+
+
+def compact_wire_carry(spec: Spec, msgs: Msg, bound: int) -> Msg:
+    """Per-receiver inbox compaction at the ROUND BOUNDARY
+    (RaftConfig.compact_wire): the keep-masked delivery view
+    [from, K, to, (E,) C] -> the first `bound` nonempty slots per
+    (receiver, cluster) in delivery order, stored [B, to(*E), C].
+
+    Identical math to models/raft.py compact_inbox (rank = cumsum of
+    nonempty over the from-major slot axis, one-hot contraction rather
+    than a gather — same reasons), run once fleet-wide instead of at the
+    next round's scan entry, so the resident wire is B slots instead of
+    K*M. Messages past the bound drop here, which is the same drop set
+    the in-round compaction produced one round later."""
+    M, K, E = spec.M, spec.K, spec.E
+    S = M * K
+    B = min(bound, S)
+    C = msgs.type.shape[-1]
+    t = msgs.type.reshape(S, M, C)
+    nonempty = t != 0                                       # [S, to, C]
+    rank = jnp.cumsum(nonempty.astype(jnp.int32), axis=0) - 1
+    sel = (
+        rank[None] == jnp.arange(B, dtype=jnp.int32)[:, None, None, None]
+    ) & nonempty[None]                                      # [B, S, to, C]
+
+    def take(name, x):
+        e = E if name in _ENT_FIELDS else 1
+        xs = x.reshape((S, M) + (() if e == 1 else (e,)) + (C,))
+        s = sel if e == 1 else sel[:, :, :, None, :]
+        if x.dtype == jnp.bool_:
+            out = (s & xs[None]).any(axis=1)
+        else:
+            out = (s.astype(x.dtype) * xs[None]).sum(axis=1)
+        return out.reshape(B, M * e, C)
+
+    return Msg(**{k: take(k, getattr(msgs, k))
+                  for k in Msg.__dataclass_fields__})
+
+
+def _unflatten_compact(spec: Spec, msgs: Msg) -> Msg:
+    """Compact storage [B, to(*E), C] -> receiver view [B, to, (E,) C]
+    (free reshape); receivers are vmapped over axis 1."""
+    M, E = spec.M, spec.E
+
+    def f(name, x):
+        if name in _ENT_FIELDS:
+            return x.reshape(x.shape[0], M, E, x.shape[-1])
+        return x
+
+    return Msg(**{k: f(k, getattr(msgs, k)) for k in Msg.__dataclass_fields__})
 
 
 def init_fleet(
@@ -370,13 +453,32 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
     relayout copies at fleet C (XLA put the tiny K/E axes layout-minor).
 
     with_drop_count: also return the number of emitted messages the
-    keep-mask killed this round (for the metrics pipeline).
+    keep-mask killed this round (for the metrics pipeline). Under
+    cfg.compact_wire the count additionally includes messages past the
+    inbox bound — the same drop set the dense program realizes one round
+    later at scan-entry compaction, counted at the boundary where it now
+    happens.
+
+    cfg.packed_state: the state argument/result is the PackedFleet
+    storage form (models/state.py); unpack/repack run inside _core, so
+    with fleet_chunks > 1 the unpacked temps are chunk-local and only
+    the packed fleet stays resident.
     """
+    if cfg.packed_state and 2 * cfg.election_tick >= (1 << PACK_TIMER_BITS):
+        # the randomized timeout is drawn in [T, 2T); a draw that cannot
+        # fit the packed timer lane would corrupt election timing
+        raise ValueError(
+            f"packed_state timer lanes hold {PACK_TIMER_BITS} bits; "
+            f"election_tick={cfg.election_tick} needs 2*T < "
+            f"{1 << PACK_TIMER_BITS}")
     node_fn = functools.partial(node_round, cfg, spec)
     # inner vmap: cluster axis (minor); outer vmap: member axis — state
-    # and inputs on axis 0, the inbox on its `to` axis (2)
+    # and inputs on axis 0, the inbox on its `to` axis (2 dense,
+    # 1 compact)
     inner = jax.vmap(node_fn, in_axes=-1, out_axes=-1)
-    vmapped = jax.vmap(inner, in_axes=(0, 2, 0, 0, 0, 0, 0, 0))
+    vmapped = jax.vmap(
+        inner, in_axes=(0, 1 if cfg.compact_wire else 2, 0, 0, 0, 0, 0, 0)
+    )
 
     def _core(
         state: NodeState,
@@ -389,23 +491,33 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
         do_tick,
         keep_mask,
     ):
+        if cfg.packed_state:
+            state = unpack_fleet(spec, state)
         if cfg.wire_int16:
             inbox = from_wire(inbox)
-        inbox5 = _unflatten_inbox(spec, inbox)  # free reshape
+        if cfg.compact_wire:
+            inbox_v = _unflatten_compact(spec, inbox)   # [B, to, (E,) C]
+        else:
+            inbox_v = _unflatten_inbox(spec, inbox)     # free reshape
         state, ob = vmapped(
-            state, inbox5, prop_len, prop_data, prop_type, ri_ctx, do_hup,
+            state, inbox_v, prop_len, prop_data, prop_type, ri_ctx, do_hup,
             do_tick,
         )
         # ob.msgs leaves are the per-node flat form batched:
-        # [from, K*to(*E), C] — already the inbox storage format
+        # [from, K*to(*E), C] — already the dense inbox storage format
         msgs = _unflatten_inbox(spec, ob.msgs)  # [from, K, to, (E,) C] view
         # self-loops (MsgHup-to-self etc.) are local, never subject to faults
         keep = keep_mask | jnp.eye(spec.M, dtype=jnp.bool_)[:, :, None]
         emitted = (msgs.type != 0).sum() if with_drop_count else None
         msgs = msgs.replace(type=jnp.where(keep[:, None, :, :], msgs.type, 0))
-        next_inbox = _flatten_inbox(spec, msgs)  # flat storage form
+        if cfg.compact_wire:
+            next_inbox = compact_wire_carry(spec, msgs, cfg.inbox_bound)
+        else:
+            next_inbox = _flatten_inbox(spec, msgs)  # flat storage form
         if cfg.wire_int16:
             next_inbox = to_wire(next_inbox)
+        if cfg.packed_state:
+            state = pack_fleet(spec, state)
         if with_drop_count:
             dropped = emitted - (next_inbox.type != 0).sum()
             return state, next_inbox, dropped
@@ -430,8 +542,10 @@ def build_round(cfg: RaftConfig, spec: Spec, with_drop_count: bool = False):
         # barrier's lowering defeated donation aliasing; without it, the
         # scheduler overlapped chunk temp sets. Both re-OOMed at 1M.)
         # Chunk i+1 slices from the updated carry: its region is untouched
-        # by earlier writes, so per-cluster math is unchanged.
-        C = args[0].term.shape[-1]
+        # by earlier writes, so per-cluster math is unchanged. (With
+        # cfg.packed_state the sliced carry is the PackedFleet — the
+        # unpacked form exists only inside _core, per chunk.)
+        C = jax.tree.leaves(args[0])[0].shape[-1]
         chunks = cfg.fleet_chunks
         if C % chunks:
             return _core(*args)
@@ -520,6 +634,16 @@ def build_kv_round(cfg: RaftConfig, spec: Spec, kvspec, member: int = 0):
             "build_kv_round needs the int32 wire (KV op words use bits "
             "0-27); construct the engine with wire_int16=False"
         )
+    if cfg.packed_state or cfg.compact_wire:
+        # the apply plane reads the bound member's log ring / applied
+        # cursor straight off the round's NodeState result — it needs the
+        # unpacked fleet and the dense wire (same class of restriction as
+        # the int16 rule above)
+        raise ValueError(
+            "build_kv_round reads the unpacked fleet (log ring, applied "
+            "cursor); construct it with packed_state=False and "
+            "compact_wire=False"
+        )
     base = build_round(cfg, spec)
     L = spec.L
 
@@ -581,12 +705,21 @@ def _jitted_kv_round(cfg: RaftConfig, spec: Spec, kvspec, member: int = 0):
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_round(cfg: RaftConfig, spec: Spec):
-    """One traced+jitted round program per (cfg, spec), shared by every
-    RaftEngine. Re-jitting per engine instance re-traces the whole round
-    (~seconds of pjit tracing each) — at suite scale that tracing, not
-    execution, dominated wall time."""
-    return jax.jit(build_round(cfg, spec))
+def _jitted_round(cfg: RaftConfig, spec: Spec, donate: bool = False):
+    """One traced+jitted round program per (cfg, spec, donate), shared by
+    every RaftEngine. Re-jitting per engine instance re-traces the whole
+    round (~seconds of pjit tracing each) — at suite scale that tracing,
+    not execution, dominated wall time.
+
+    ``donate=True`` donates the fleet carry (state + inbox): XLA aliases
+    the output buffers onto the inputs, so a dispatch updates the fleet
+    in place instead of holding two copies across it — the difference
+    between chunk-free and chunk-forced at large C. The caller's old
+    references are DELETED by the runtime after the call (reuse raises
+    a deleted-buffer error; tests/test_donation.py); interactive/debug
+    drivers that re-inspect a pre-round fleet must keep donate=False."""
+    return jax.jit(build_round(cfg, spec),
+                   donate_argnums=(0, 1) if donate else ())
 
 
 class RaftEngine:
@@ -600,14 +733,25 @@ class RaftEngine:
         voters=None,
         learners=None,
         seed: int = 0,
+        donate: bool = False,
     ):
+        """``donate=False`` (the default) is the interactive/debug path:
+        every round's input buffers stay live, so callers may hold and
+        re-inspect ``engine.state`` snapshots across steps. Perf drivers
+        pass donate=True to single-buffer the fleet (step() reassigns
+        the carry, so the engine itself never reuses a donated ref)."""
         self.spec, self.cfg, self.C = spec, cfg, C
         self.state = init_fleet(
             spec, C, voters, learners, seed, election_tick=cfg.election_tick
         )
-        self.inbox = empty_inbox(spec, C, wire_int16=cfg.wire_int16)
+        if cfg.packed_state:
+            self.state = pack_fleet(spec, self.state)
+        self.inbox = empty_inbox(
+            spec, C, wire_int16=cfg.wire_int16,
+            compact_bound=cfg.inbox_bound if cfg.compact_wire else 0,
+        )
         self.keep_mask = jnp.ones((spec.M, spec.M, C), jnp.bool_)
-        self._round = _jitted_round(cfg, spec)
+        self._round = _jitted_round(cfg, spec, donate)
 
     # -- one lockstep round -------------------------------------------------
     def step(

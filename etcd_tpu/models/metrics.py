@@ -145,8 +145,18 @@ def build_metered_round(cfg: RaftConfig, spec: Spec):
 
     The metric math is a handful of elementwise reductions over state
     the round already touches — XLA fuses them into the same program, so
-    the marginal cost is one small add per counter.
+    the marginal cost is one small add per counter. The compacted wire
+    carry (RaftConfig.compact_wire) composes fine — `delivered` then
+    counts post-compaction slots, i.e. messages that can still be
+    consumed; packed_state does not (the counters read roles/cursors off
+    the unpacked fleet), so perf drivers unpack at the boundary
+    (bench.py does).
     """
+    if cfg.packed_state:
+        raise ValueError(
+            "build_metered_round reads the unpacked fleet; unpack at the "
+            "boundary (models/state.py unpack_fleet) and meter with "
+            "packed_state=False")
     round_fn = build_round(cfg, spec, with_drop_count=True)
 
     def metered(state: NodeState, inbox, prop_len, prop_data, prop_type,

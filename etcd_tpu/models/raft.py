@@ -1526,7 +1526,9 @@ def node_round(
     cfg: RaftConfig,
     spec: Spec,
     n: NodeState,
-    inbox: Msg,  # leaves [M(from), K, ...]
+    inbox: Msg,  # leaves [M(from), K, ...]; pre-compacted [B, ...] under
+                 # cfg.compact_wire (the engine moved the per-receiver
+                 # compaction to the round boundary)
     prop_len,    # i32 scalar: entries proposed locally this round
     prop_data,   # i32[E]
     prop_type,   # i32[E]
@@ -1579,11 +1581,17 @@ def node_round(
     if do_hup_step:
         n, ob = process_message(cfg, spec, n, ob, hup_msg)
 
-    flat = jax.tree.map(
-        lambda x: x.reshape((spec.M * spec.K,) + x.shape[2:]), inbox
-    )
-    if cfg.inbox_bound:
-        flat = compact_inbox(spec, flat, cfg.inbox_bound)
+    if cfg.compact_wire:
+        # the engine compacted this inbox at the previous round's
+        # boundary (engine.compact_wire_carry): leaves are already the
+        # first-`inbox_bound` nonempty slots in delivery order
+        flat = inbox
+    else:
+        flat = jax.tree.map(
+            lambda x: x.reshape((spec.M * spec.K,) + x.shape[2:]), inbox
+        )
+        if cfg.inbox_bound:
+            flat = compact_inbox(spec, flat, cfg.inbox_bound)
     # Scan the message slots. A straight-line unroll was tried (rounds 1-3)
     # and removed: on TPU the per-step optimization barriers it needed to
     # bound peak HBM shattered the round into ~13k unfusable ops whose fixed
@@ -1591,12 +1599,28 @@ def node_round(
     # unrolled compile was pathological (>6GB compile RSS even at C=1,
     # SIGSEGV in the full suite). The scan form runs the same math with one
     # while iteration per slot; the throughput lever is batch scale C.
-    def body(carry, m):
-        nn, oo = carry
-        nn, oo = process_message(cfg, spec, nn, oo, m)
-        return (nn, oo), None
+    if cfg.sparse_outbox:
+        # the dense outbox leaves the scan carry entirely (the completion
+        # of PROFILE.md's emission restructure): under the validated
+        # message classes every reachable in-scan handler records
+        # PendingWire intents, so the carry is (NodeState, PendingWire)
+        # and the [K, M] planes are only written by the post-scan merge.
+        # `ob` is closed over as a scan constant; its msgs/counts are
+        # provably untouched inside the body (RaftConfig.sparse_outbox).
+        def body(carry, m):
+            nn, pend = carry
+            nn, oo = process_message(cfg, spec, nn, ob.replace(pend=pend), m)
+            return (nn, oo.pend), None
 
-    (n, ob), _ = jax.lax.scan(body, (n, ob), flat)
+        (n, pend), _ = jax.lax.scan(body, (n, ob.pend), flat)
+        ob = ob.replace(pend=pend)
+    else:
+        def body(carry, m):
+            nn, oo = carry
+            nn, oo = process_message(cfg, spec, nn, oo, m)
+            return (nn, oo), None
+
+        (n, ob), _ = jax.lax.scan(body, (n, ob), flat)
 
     if do_prop_step:
         n, ob = process_message(cfg, spec, n, ob, prop_msg)

@@ -23,6 +23,8 @@ Design notes vs the reference:
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -268,3 +270,258 @@ def in_config_self(n: NodeState) -> jnp.ndarray:
     """Whether this node has a Progress entry, i.e. is voter/outgoing/learner."""
     self_hot = jnp.arange(n.voters.shape[0], dtype=jnp.int32) == n.nid
     return (self_hot & (n.voters | n.voters_out | n.learners)).any()
+
+
+# ---------------------------------------------------------------------------
+# Packed fleet storage — the "fleet memory diet" (RaftConfig.packed_state)
+#
+# The resident fleet's bytes/group, not its FLOPs, is what forces the
+# fleet-chunk loop above ~131k groups/shard (PROFILE.md roofline): most
+# NodeState leaves are bools, 2-bit enums, node ids, or small counters
+# stored as int32/bool arrays. The packed form carries the SAME information
+# in three dense planes per node:
+#
+#   bits    u32[NB]  every narrow field (roles, ids, vote bitmaps, guard
+#                    flags, timers, counters, pr_state, log_type) bit-packed
+#                    into 32-bit lanes
+#   narrow  i16[NI]  every index/term-valued field (ring terms, match/next,
+#                    inflight ends, cursors) under the wire_int16-class
+#                    range contract (values < 32768 at bench/chaos horizons)
+#   wide    i32[NW]  full-width fields: the two rolling hashes and the
+#                    log_data payload words (device-MVCC words use 28 bits)
+#   rng     u32[2]   the per-node PRNG key, passthrough
+#
+# ~2.4x smaller than NodeState at the bench geometry. pack/unpack are pure
+# elementwise shift/mask chains that XLA fuses into the neighboring round
+# program; with fleet_chunks they run INSIDE the chunk loop so the unpacked
+# temps stay chunk-local. The crash-durability machinery is untouched: it
+# operates on the unpacked NodeState between unpack and repack, so the
+# classification table above stays the single source of truth.
+#
+# A NodeState field added without a row in the pack plan fails
+# tests/test_packed_state.py (same enforcement pattern as the durability
+# table), and the bytes budget there keeps a new leaf from silently
+# re-inflating the fleet.
+# ---------------------------------------------------------------------------
+
+# Packed timer lanes: election_elapsed / heartbeat_elapsed / randomized_
+# timeout each get this many bits. Requires 2 * election_tick <
+# 2**PACK_TIMER_BITS (models/engine.py validates at build time); the two
+# elapsed counters SATURATE at the cap, which is exact for promotable nodes
+# (elapsed resets at the timeout) and semantically equivalent for
+# non-promotable ones (any elapsed >= the randomized timeout behaves the
+# same: the fire/lease comparisons are already past their thresholds).
+PACK_TIMER_BITS = 10
+_PACK_SATURATING = ("election_elapsed", "heartbeat_elapsed")
+
+_PACK_BOOL_FIELDS = frozenset({
+    "snap_auto_leave", "auto_leave",
+    "probe_sent", "recent_active", "votes_responded", "votes_granted",
+    "voters", "voters_out", "learners", "learners_next",
+    "snap_voters", "snap_voters_out", "snap_learners", "snap_learners_next",
+    "ro_acks",
+})
+
+
+class PackedFleet(struct.PyTreeNode):
+    """A NodeState fleet in packed storage (leaves keep the engine's
+    members-leading / clusters-minor convention: [M, lanes, C])."""
+
+    bits: jnp.ndarray    # u32[M, NB, C]
+    narrow: jnp.ndarray  # i16[M, NI, C]
+    wide: jnp.ndarray    # i32[M, NW, C]
+    rng_key: jnp.ndarray # u32[M, 2, C] passthrough
+
+
+@functools.lru_cache(maxsize=16)
+def pack_plan(spec: Spec):
+    """The static packing layout for one Spec: (bit_rows, bit_lanes,
+    narrow_rows, wide_rows) where bit_rows maps every narrow field to
+    per-element (lane, offset) slots, and narrow/wide rows are
+    (name, count, offset) runs in the i16/i32 planes."""
+    M, L, R, W = spec.M, spec.L, spec.R, spec.W
+    idb = max(M.bit_length(), 1)          # ids stored with +1 bias: 0..M
+    cnt = max(R.bit_length(), 1)          # queue counters: 0..R
+    tb = PACK_TIMER_BITS
+    bit_fields = (
+        # (name, bits/element, elements, bias)
+        ("nid", idb, 1, 0),
+        ("role", 2, 1, 0),
+        ("lead", idb, 1, 1),
+        ("vote", idb, 1, 1),
+        ("lead_transferee", idb, 1, 1),
+        ("snap_auto_leave", 1, 1, 0),
+        ("auto_leave", 1, 1, 0),
+        ("election_elapsed", tb, 1, 0),
+        ("heartbeat_elapsed", tb, 1, 0),
+        ("randomized_timeout", tb, 1, 0),
+        ("ro_count", cnt, 1, 0),
+        ("ro_pend_count", cnt, 1, 0),
+        ("rs_count", cnt, 1, 0),
+        ("pr_state", 2, M, 0),
+        ("probe_sent", 1, M, 0),
+        ("recent_active", 1, M, 0),
+        ("votes_responded", 1, M, 0),
+        ("votes_granted", 1, M, 0),
+        ("voters", 1, M, 0),
+        ("voters_out", 1, M, 0),
+        ("learners", 1, M, 0),
+        ("learners_next", 1, M, 0),
+        ("snap_voters", 1, M, 0),
+        ("snap_voters_out", 1, M, 0),
+        ("snap_learners", 1, M, 0),
+        ("snap_learners_next", 1, M, 0),
+        ("infl_start", max((W - 1).bit_length(), 1), M, 0),
+        ("infl_count", max(W.bit_length(), 1), M, 0),
+        ("ro_acks", 1, R * M, 0),
+        ("ro_from", idb, R, 1),
+        ("ro_pend_from", idb, R, 1),
+        ("log_type", 2, L, 0),
+    )
+    # greedy lane fill; an element never straddles two lanes
+    bit_rows, lane, off = [], 0, 0
+    for name, bits, count, bias in bit_fields:
+        slots = []
+        for _ in range(count):
+            if off + bits > 32:
+                lane, off = lane + 1, 0
+            slots.append((lane, off))
+            off += bits
+        bit_rows.append((name, bits, bias, tuple(slots)))
+    n_lanes = lane + 1
+
+    def runs(fields):
+        rows, o = [], 0
+        for name, count in fields:
+            rows.append((name, count, o))
+            o += count
+        return tuple(rows), o
+
+    narrow_rows, n_narrow = runs((
+        ("term", 1), ("commit", 1), ("last_index", 1), ("applied", 1),
+        ("snap_index", 1), ("snap_term", 1), ("pending_conf_index", 1),
+        ("uncommitted_size", 1),
+        ("match", M), ("next_idx", M), ("pending_snapshot", M),
+        ("infl_ends", M * W),
+        ("log_term", L),
+        ("ro_ctx", R), ("ro_index", R), ("ro_pend_ctx", R),
+        ("rs_ctx", R), ("rs_index", R),
+    ))
+    wide_rows, n_wide = runs((
+        ("applied_hash", 1), ("snap_hash", 1), ("log_data", L),
+    ))
+    covered = ({r[0] for r in bit_rows}
+               | {r[0] for r in narrow_rows}
+               | {r[0] for r in wide_rows} | {"rng_key"})
+    missing = set(NodeState.__dataclass_fields__) - covered
+    extra = covered - set(NodeState.__dataclass_fields__)
+    if missing or extra:
+        # a new NodeState leaf MUST be classified here, exactly like the
+        # durability table — an unpacked stray would silently vanish
+        # across a packed round
+        raise ValueError(
+            f"pack_plan out of sync with NodeState: missing={sorted(missing)} "
+            f"extra={sorted(extra)}")
+    return bit_rows, n_lanes, narrow_rows, n_narrow, wide_rows, n_wide
+
+
+def _rows3(x: jnp.ndarray) -> jnp.ndarray:
+    """Fleet leaf [M, C] or [M, count, C] -> [M, count, C]."""
+    return x[:, None, :] if x.ndim == 2 else x
+
+
+def pack_fleet(spec: Spec, state: NodeState) -> PackedFleet:
+    """NodeState fleet ([M, ..., C] leaves) -> packed storage. Values are
+    masked to their declared widths (the wire_int16-style range contract;
+    the two elapsed timers saturate instead — see PACK_TIMER_BITS)."""
+    bit_rows, n_lanes, narrow_rows, _, wide_rows, _ = pack_plan(spec)
+    M = spec.M
+    C = state.term.shape[-1]
+    lanes = [jnp.zeros((M, C), jnp.uint32) for _ in range(n_lanes)]
+    for name, bits, bias, slots in bit_rows:
+        x = _rows3(getattr(state, name))
+        if name in _PACK_BOOL_FIELDS:
+            v = x.astype(jnp.uint32)
+        else:
+            v = x.astype(jnp.int32) + bias
+            if name in _PACK_SATURATING:
+                v = jnp.minimum(v, (1 << bits) - 1)
+            v = (v & ((1 << bits) - 1)).astype(jnp.uint32)
+        for k, (lane, off) in enumerate(slots):
+            lanes[lane] = lanes[lane] | (v[:, k, :] << jnp.uint32(off))
+    bits_plane = jnp.stack(lanes, axis=1)
+    narrow = jnp.concatenate(
+        [_rows3(getattr(state, name)).astype(jnp.int16)
+         for name, _, _ in narrow_rows], axis=1)
+    wide = jnp.concatenate(
+        [_rows3(getattr(state, name)).astype(jnp.int32)
+         for name, _, _ in wide_rows], axis=1)
+    return PackedFleet(bits=bits_plane, narrow=narrow, wide=wide,
+                       rng_key=state.rng_key)
+
+
+def _unpack_bits_row(packed: PackedFleet, name, bits, bias, slots):
+    mask = jnp.uint32((1 << bits) - 1)
+    cols = [
+        (packed.bits[:, lane, :] >> jnp.uint32(off)) & mask
+        for (lane, off) in slots
+    ]
+    v = jnp.stack(cols, axis=1)
+    x = (v != 0) if name in _PACK_BOOL_FIELDS \
+        else v.astype(jnp.int32) - bias
+    return x[:, 0, :] if len(slots) == 1 else x
+
+
+def _unpack_plane_row(plane: jnp.ndarray, count, off):
+    x = plane[:, off:off + count, :].astype(jnp.int32)
+    return x[:, 0, :] if count == 1 else x
+
+
+def unpack_fleet(spec: Spec, packed: PackedFleet) -> NodeState:
+    """Packed storage -> NodeState fleet; exact inverse of pack_fleet on
+    every in-contract value (int16 sign-extension round-trips everything
+    below 32768, including the NONE_ID sentinels)."""
+    bit_rows, _, narrow_rows, _, wide_rows, _ = pack_plan(spec)
+    out = {"rng_key": packed.rng_key}
+    for name, bits, bias, slots in bit_rows:
+        out[name] = _unpack_bits_row(packed, name, bits, bias, slots)
+    for rows, plane in ((narrow_rows, packed.narrow),
+                        (wide_rows, packed.wide)):
+        for name, count, off in rows:
+            out[name] = _unpack_plane_row(plane, count, off)
+    return NodeState(**out)
+
+
+def unpack_field(spec: Spec, packed: PackedFleet, name: str) -> jnp.ndarray:
+    """ONE NodeState field off the packed storage, without materializing
+    the whole unpacked fleet — the probe drivers use between timed
+    dispatches (e.g. bench.py reading `commit` at 1M groups, where a
+    full unpack is a multi-GB transient)."""
+    if name == "rng_key":
+        return packed.rng_key
+    bit_rows, _, narrow_rows, _, wide_rows, _ = pack_plan(spec)
+    for fname, bits, bias, slots in bit_rows:
+        if fname == name:
+            return _unpack_bits_row(packed, name, bits, bias, slots)
+    for rows, plane in ((narrow_rows, packed.narrow),
+                        (wide_rows, packed.wide)):
+        for fname, count, off in rows:
+            if fname == name:
+                return _unpack_plane_row(plane, count, off)
+    raise KeyError(name)
+
+
+def state_bytes_per_group(spec: Spec, packed: bool = False) -> int:
+    """Resident bytes per group (M nodes) of the fleet state in the given
+    storage form, computed from the actual leaf dtypes/shapes — the number
+    bench.py reports and the regression budget guards."""
+    if packed:
+        _, nb, _, ni, _, nw = pack_plan(spec)
+        return spec.M * (nb * 4 + ni * 2 + nw * 4 + 2 * 4)
+    import math
+
+    sh = jax.eval_shape(
+        lambda: init_node(spec, 0, jnp.zeros((spec.M,), jnp.bool_)))
+    return spec.M * sum(
+        math.prod(leaf.shape) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(sh))

@@ -114,12 +114,29 @@ def _constrain(mesh: Mesh, tree):
 def fleet_in_specs(cfg: RaftConfig, spec: Spec, mesh: Mesh | None = None):
     """Per-leaf PartitionSpecs (trailing axis on the mesh) for the 9 round
     args: (state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup,
-    do_tick, keep_mask). Computed abstractly — no arrays materialised."""
+    do_tick, keep_mask). Computed abstractly — no arrays materialised.
+    Honors the cfg's storage forms: PackedFleet leaves under packed_state,
+    the [B, to, C] compacted wire under compact_wire — every diet leaf
+    keeps the trailing clusters axis, so the sharding rule is unchanged."""
     axes = _mesh_axes(mesh) if mesh is not None else CLUSTER_AXIS
-    st = jax.eval_shape(
-        lambda: init_fleet(spec, 2, election_tick=cfg.election_tick)
+
+    def mk_state():
+        st = init_fleet(spec, 2, election_tick=cfg.election_tick)
+        if cfg.packed_state:
+            from etcd_tpu.models.state import pack_fleet
+
+            st = pack_fleet(spec, st)
+        return st
+
+    st = jax.eval_shape(mk_state)
+    # the inbox is built EAGERLY (a few KB at C=2): empty_inbox routes
+    # through the lru-cached types.empty_msg, and eval_shape would
+    # poison that cache with tracer leaves for this (spec, backend) key
+    # (see engine.inbox_bytes_per_group)
+    ib = empty_inbox(
+        spec, 2, wire_int16=cfg.wire_int16,
+        compact_bound=cfg.inbox_bound if cfg.compact_wire else 0,
     )
-    ib = jax.eval_shape(lambda: empty_inbox(spec, 2))
     state_specs = jax.tree.map(lambda x: _last_axis_p(x, axes), st)
     inbox_specs = jax.tree.map(lambda x: _last_axis_p(x, axes), ib)
     v2 = P(None, axes)
@@ -127,9 +144,16 @@ def fleet_in_specs(cfg: RaftConfig, spec: Spec, mesh: Mesh | None = None):
     return (state_specs, inbox_specs, v2, v3, v3, v2, v2, v2, v3)
 
 
-def build_sharded_round(cfg: RaftConfig, spec: Spec, mesh: Mesh):
+def build_sharded_round(cfg: RaftConfig, spec: Spec, mesh: Mesh,
+                        donate: bool = True):
     """Jitted round with all inputs/outputs constrained to the clusters
-    sharding. Identical math to engine.build_round; placement only."""
+    sharding. Identical math to engine.build_round; placement only.
+
+    ``donate=True`` (default) donates the fleet carry (state + inbox):
+    the per-round dispatch updates the sharded fleet in place instead of
+    double-buffering GBs of HBM across it. Callers that re-read a
+    pre-round fleet reference (reuse raises a deleted-buffer error) pass
+    donate=False — the interactive/debug fallback."""
     round_fn = build_round(cfg, spec)
 
     def constrained(*args):
@@ -137,13 +161,15 @@ def build_sharded_round(cfg: RaftConfig, spec: Spec, mesh: Mesh):
         state, inbox = round_fn(*args)
         return _constrain(mesh, state), _constrain(mesh, inbox)
 
-    return jax.jit(constrained)
+    return jax.jit(constrained, donate_argnums=(0, 1) if donate else ())
 
 
-def build_shard_map_round(cfg: RaftConfig, spec: Spec, mesh: Mesh):
+def build_shard_map_round(cfg: RaftConfig, spec: Spec, mesh: Mesh,
+                          donate: bool = True):
     """shard_map form: each device steps its C/n_devices cluster shard
     locally. Composes with cross-shard collectives (psum of invariant
-    violations etc.) and nested member-axis sharding later."""
+    violations etc.) and nested member-axis sharding later. Donation as
+    in build_sharded_round (donate=False = non-donated fallback)."""
     round_fn = build_round(cfg, spec)
     in_specs = fleet_in_specs(cfg, spec, mesh)
 
@@ -154,7 +180,7 @@ def build_shard_map_round(cfg: RaftConfig, spec: Spec, mesh: Mesh):
         out_specs=(in_specs[0], in_specs[1]),
         check_rep=False,
     )
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(0, 1) if donate else ())
 
 
 def build_global_invariants(cfg: RaftConfig, spec: Spec, mesh: Mesh):

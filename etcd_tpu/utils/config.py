@@ -124,6 +124,50 @@ class RaftConfig:
     # MSG_TRANSFER_LEADER is not in any steady message_classes, so no
     # transfer can start). Off for golden/test paths.
     deferred_emit: bool = False
+    # The fleet memory diet, part 1 (PROFILE.md round 6): carry the fleet
+    # state BETWEEN rounds in the bit/width-packed storage form
+    # (models/state.py PackedFleet) instead of the full NodeState pytree.
+    # The round program unpacks at entry and repacks at exit — with
+    # fleet_chunks > 1 the pack/unpack happens INSIDE the chunk loop, so
+    # the unpacked temps are chunk-local and the resident fleet is the
+    # ~2.4x-smaller packed form. SCALE MODE ONLY, two contracts (both the
+    # wire_int16 class of range contracts): (a) every index/term-valued
+    # field must stay below 32768 (bench/chaos horizons, not long-lived
+    # servers); (b) 2 * election_tick must fit the packed timer lanes
+    # (state.py PACK_TIMER_BITS; validated at build time). Timer lanes
+    # SATURATE at their cap — exact for promotable nodes (elapsed resets
+    # at the timeout), and semantically equivalent for non-promotable
+    # nodes whose elapsed grows without firing (any value >= the
+    # randomized timeout behaves identically). Bit-identical trajectories
+    # vs the unpacked program are proven by tests/test_packed_state.py.
+    packed_state: bool = False
+    # The fleet memory diet, part 2: complete PROFILE.md's emission
+    # restructure by removing the dense outbox from the message-scan
+    # carry ENTIRELY. Requires deferred_emit and a message_classes
+    # declaration under which every in-scan handler records PendingWire
+    # intents instead of emitting ({MSG_APP, MSG_APP_RESP, MSG_PROP} —
+    # exactly the steady wire traffic): the scan then carries only
+    # (NodeState, PendingWire) and the K-slot outbox is packed ONCE by
+    # the post-scan merge, so XLA never round-trips the [K, M] message
+    # planes through the serial slot loop's carry. Bit-identical to the
+    # deferred program by construction (the dropped carry leaves are
+    # provably never written inside the scan; tests/test_sparse_outbox.py
+    # proves it against the immediate-emission program end to end).
+    sparse_outbox: bool = False
+    # The fleet memory diet, part 3: store the carried inter-round
+    # message tensor in the inbox-compacted form — [bound, M(to), C]
+    # slots instead of the dense [M(from), K*M(to), C] plane. Requires
+    # inbox_bound > 0. The per-receiver compaction node_round already
+    # performs at scan entry moves to the round BOUNDARY (after the
+    # keep-mask, before storage), so the resident wire shrinks K*M/bound
+    # x (10 -> 4 slots at the bench geometry) and the next round scans
+    # the stored slots directly. Bit-identical to the dense carry by
+    # construction: same messages, same order, same drop set — proven
+    # over full-program scenarios (elections, drops, snapshots) by
+    # tests/test_sparse_outbox.py. NOT for the chaos tiers: the held-
+    # buffer delay machinery and crash traffic wipes address the dense
+    # [from, K, to] plane (harness/chaos.py validates).
+    compact_wire: bool = False
     # Store the carried inter-round message tensor (the "wire") as int16
     # instead of int32: halves the resident inbox, which at the 1M-group
     # configuration is the largest single fleet buffer. Casts happen at
@@ -173,6 +217,29 @@ class RaftConfig:
                 raise ValueError(
                     f"unknown entry_classes {sorted(bad)}; known: "
                     "['conf_change', 'normal']")
+        if self.sparse_outbox:
+            from etcd_tpu import types as _t
+
+            if not self.deferred_emit:
+                raise ValueError("sparse_outbox requires deferred_emit "
+                                 "(the scan-body handlers must record "
+                                 "PendingWire intents, not emit)")
+            steady = {_t.MSG_APP, _t.MSG_APP_RESP, _t.MSG_PROP}
+            if self.message_classes is None or \
+                    not set(self.message_classes) <= steady:
+                # soundness is BY CONSTRUCTION: under these classes every
+                # reachable in-scan handler is a PendingWire recorder, so
+                # dropping the outbox planes from the scan carry cannot
+                # lose a write. Any wider class set has in-scan emit
+                # sites (votes, heartbeats, snapshots, forwards) whose
+                # writes would be silently discarded.
+                raise ValueError(
+                    "sparse_outbox requires message_classes ⊆ "
+                    "{MSG_APP, MSG_APP_RESP, MSG_PROP} — other handler "
+                    "classes emit inside the scan")
+        if self.compact_wire and self.inbox_bound <= 0:
+            raise ValueError("compact_wire stores the inbox in its "
+                             "compacted form and needs inbox_bound > 0")
         if self.deferred_emit and not self.coalesce_commit_refresh:
             # without coalescing, the leader's per-ack commit broadcast
             # fires inside the scan — exactly the write the deferral is

@@ -46,3 +46,12 @@ def env_int(prog: str, name: str, default: str | None,
         span = (f"[{lo}, {hi}]" if hi is not None else f">= {lo}")
         knob_error(prog, f"{name}={raw} outside {span}")
     return v
+
+
+def env_bool(prog: str, name: str, default: str) -> bool:
+    """0/1 flag with the same exit-2 contract (a typo'd BENCH_PACKED=yes
+    must not silently select the 0 branch)."""
+    raw = os.environ.get(name, default)
+    if raw not in ("0", "1"):
+        knob_error(prog, f"{name}={raw!r} is not 0 or 1")
+    return raw == "1"

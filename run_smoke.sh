@@ -17,7 +17,12 @@
 # the device-MVCC apply plane's fast tier (tests/test_device_mvcc.py:
 # differential fuzz at <=128 groups, engine/kvserver integration; the
 # 4096-group acceptance fuzz stays behind -m slow) — the apply plane
-# consumes the frontier these state machines produce.
+# consumes the frontier these state machines produce. The fleet-memory-
+# diet equivalence tiers run here too: packed-state/compact-wire
+# full-program bit-identity (tests/test_packed_state.py, C=16),
+# sparse-outbox steady bit-identity (tests/test_sparse_outbox.py) and
+# fleet-carry donation safety (tests/test_donation.py) — they guard the
+# same round program this tier exists for.
 cd "$(dirname "$0")"
 exec python -m pytest -q -m 'not slow' \
   tests/test_datadriven_quorum.py \
@@ -32,6 +37,9 @@ exec python -m pytest -q -m 'not slow' \
   tests/test_local_steps.py \
   tests/test_deferred_emit.py \
   tests/test_apply_specialization.py \
+  tests/test_packed_state.py \
+  tests/test_sparse_outbox.py \
+  tests/test_donation.py \
   tests/test_sparse_held.py \
   tests/test_recovery_crash.py \
   tests/test_recovery_member.py \

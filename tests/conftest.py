@@ -70,7 +70,10 @@ def pytest_configure(config):
         "slow: full-scale tiers excluded from the tier-1 run "
         "(-m 'not slow'); e.g. the 262k-group crash-chaos run and the "
         "4096-group device-MVCC acceptance fuzz (no new marker needed "
-        "for the apply plane — its scale shapes ride this one)")
+        "for the apply plane — its scale shapes ride this one; the "
+        "fleet-memory-diet equivalence suites keep their fast C<=16 "
+        "shapes unmarked and any future large-C variant rides this "
+        "marker too)")
 
 
 def bootstrap_cert_cn_auth(call):

@@ -575,6 +575,14 @@ def test_kvserver_device_plane_crash_recovery():
      "APPLY_KEYS"),
     ("chaos_run.py", {"APPLY_KEYS": "-1"}, "APPLY_KEYS"),
     ("chaos_run.py", {"APPLY_KEYS": "64", "APPLY_OPS": "0"}, "APPLY_OPS"),
+    # the headline-bench knobs ride the same validator now (they used to
+    # be raw int() casts that died with a bare ValueError traceback)
+    ("bench.py", {"BENCH_CHUNKS": "zero"}, "BENCH_CHUNKS"),
+    ("bench.py", {"BENCH_CHUNKS": "0"}, "BENCH_CHUNKS"),
+    ("bench.py", {"BENCH_C": "-8"}, "BENCH_C"),
+    ("bench.py", {"APPLY_MODE": "device", "BENCH_CHUNKS": "1.5"},
+     "BENCH_CHUNKS"),
+    ("bench.py", {"BENCH_PACKED": "yes"}, "BENCH_PACKED"),
 ])
 def test_apply_knob_validation_exits_2(script, env_extra, needle):
     """Bad APPLY_* values exit 2 with a pointed message before any device

@@ -1,0 +1,190 @@
+"""RaftConfig.packed_state / compact_wire: the fleet memory diet.
+
+Equivalence contract (the tentpole's proof obligation): the FULL round
+program carried in packed storage (bit-packed int32 lanes + int16 index
+planes, models/state.py PackedFleet) and/or with the compacted wire
+([bound, to, C] instead of the dense [from, K*to, C]) reproduces the
+dense program BIT-FOR-BIT over a scenario that exercises elections,
+replication, partitions, read-index waves and ticks — the
+tests/test_mesh_equivalence.py scenario style. The chunked packed
+program additionally proves the pack/unpack is chunk-local-safe (the
+sliced carry is the packed form).
+
+Guard rails: every NodeState field must be classified in the pack plan
+(like the crash-durability table), and the bytes/group budget keeps a
+future leaf addition from silently re-inflating the resident fleet.
+"""
+import dataclasses
+
+import numpy as np
+import jax
+import pytest
+
+from etcd_tpu.models.engine import (
+    build_round,
+    empty_inbox,
+    inbox_bytes_per_group,
+    init_fleet,
+)
+from etcd_tpu.models.state import (
+    NodeState,
+    pack_fleet,
+    pack_plan,
+    state_bytes_per_group,
+    unpack_fleet,
+)
+from etcd_tpu.types import ENTRY_NORMAL, ROLE_LEADER, Spec
+from etcd_tpu.utils.config import RaftConfig
+
+SPEC = Spec(M=3, L=16, E=1, K=2, W=2, R=2, A=2)
+CFG = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=2,
+                 inbox_bound=4)
+C = 16
+ROUNDS = 48
+
+
+def _inputs(r: int):
+    """Elections at r=0, proposals on even rounds, a partition window
+    long enough that the L=16 ring compacts past the laggard (snapshot
+    fallback), one read-index wave, ticks every 3rd round."""
+    M, E = SPEC.M, SPEC.E
+    hup = np.zeros((M, C), bool)
+    if r == 0:
+        for c in range(C):
+            hup[c % M, c] = True
+    plen = np.zeros((M, C), np.int32)
+    pdata = np.zeros((M, E, C), np.int32)
+    ptype = np.zeros((M, E, C), np.int32)
+    if 2 <= r < ROUNDS - 10:
+        plen[0, :] = 1
+        pdata[0, 0, :] = r * 64 + np.arange(C)
+        ptype[0, 0, :] = ENTRY_NORMAL
+    ri = np.zeros((M, C), np.int32)
+    if r == 24:
+        ri[0, :] = 7
+    keep = np.ones((M, M, C), bool)
+    if 8 <= r < 18:
+        keep[1, :, 4:8] = False
+        keep[:, 1, 4:8] = False
+    tick = np.full((M, C), r % 3 == 0 or r >= ROUNDS - 8, bool)
+    return plen, pdata, ptype, ri, hup, tick, keep
+
+
+def _run(cfg, unpack=False, compact=False):
+    round_fn = jax.jit(build_round(cfg, SPEC))
+    state = init_fleet(SPEC, C, seed=0, election_tick=cfg.election_tick)
+    if cfg.packed_state:
+        state = pack_fleet(SPEC, state)
+    inbox = empty_inbox(
+        SPEC, C, compact_bound=cfg.inbox_bound if cfg.compact_wire else 0)
+    states = []
+    for r in range(ROUNDS):
+        state, inbox = round_fn(state, inbox, *_inputs(r))
+        states.append(unpack_fleet(SPEC, state) if cfg.packed_state
+                      else state)
+    return states
+
+
+def _assert_trajectories_equal(ref, got, label):
+    for r, (a, b) in enumerate(zip(ref, got)):
+        for name in NodeState.__dataclass_fields__:
+            assert np.array_equal(
+                np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+            ), f"{label}: state.{name} diverged at round {r}"
+
+
+@pytest.fixture(scope="module")
+def dense_run():
+    states = _run(CFG)
+    last = states[-1]
+    # the proof only matters if the scenario is rich: steady leaders,
+    # deep replication, ring compaction past the partitioned laggard
+    role = np.asarray(last.role)
+    assert ((role == ROLE_LEADER).sum(axis=0) == 1).all()
+    assert (np.asarray(last.snap_index) > 0).any(), "no ring compaction"
+    assert int(np.asarray(last.commit).min()) >= 8
+    return states
+
+
+def test_packed_program_is_bit_identical(dense_run):
+    got = _run(dataclasses.replace(CFG, packed_state=True))
+    _assert_trajectories_equal(dense_run, got, "packed")
+
+
+def test_packed_chunked_program_is_bit_identical(dense_run):
+    """fleet_chunks slices the PACKED carry; unpack/repack happen inside
+    the chunk body, so unpacked temps stay chunk-local — and the math
+    must not change."""
+    got = _run(dataclasses.replace(CFG, packed_state=True, fleet_chunks=2))
+    _assert_trajectories_equal(dense_run, got, "packed+chunked")
+
+
+def test_compact_wire_program_is_bit_identical(dense_run):
+    """The boundary-compacted [B, to, C] wire carry is the same messages
+    in the same order as scan-entry compaction of the dense carry."""
+    got = _run(dataclasses.replace(CFG, compact_wire=True))
+    _assert_trajectories_equal(dense_run, got, "compact_wire")
+
+
+def test_pack_roundtrip_is_exact():
+    st = init_fleet(SPEC, 8, seed=3)
+    rt = unpack_fleet(SPEC, pack_fleet(SPEC, st))
+    for name in NodeState.__dataclass_fields__:
+        a, b = np.asarray(getattr(st, name)), np.asarray(getattr(rt, name))
+        assert a.dtype == b.dtype, name
+        assert np.array_equal(a, b), name
+
+
+def test_unpack_field_matches_full_unpack():
+    """The single-field probe (bench's commit read at scale) must agree
+    with the full unpack for every field class: bits, narrow, wide and
+    the rng passthrough."""
+    from etcd_tpu.models.state import unpack_field
+
+    pk = pack_fleet(SPEC, init_fleet(SPEC, 8, seed=3))
+    full = unpack_fleet(SPEC, pk)
+    for name in ("commit", "applied_hash", "role", "voters", "log_type",
+                 "rng_key"):
+        assert np.array_equal(
+            np.asarray(unpack_field(SPEC, pk, name)),
+            np.asarray(getattr(full, name))), name
+    with pytest.raises(KeyError):
+        unpack_field(SPEC, pk, "not_a_field")
+
+
+def test_pack_plan_covers_every_field():
+    """A NodeState leaf added without a pack-plan row must fail loudly
+    (same enforcement as the crash-durability table): pack_plan raises on
+    any coverage gap, so building it IS the check — for several Specs."""
+    for spec in (SPEC, Spec(), Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)):
+        pack_plan(spec)
+
+
+def test_packed_timer_lane_validation():
+    with pytest.raises(ValueError, match="timer lanes"):
+        build_round(
+            RaftConfig(election_tick=600, packed_state=True), SPEC)
+
+
+def test_bytes_per_group_budget():
+    """The regression guard: the bench geometry's resident bytes/group,
+    computed from the actual leaf dtypes/shapes. A new NodeState or Msg
+    leaf that re-inflates the diet past budget fails here instead of
+    silently resurrecting the fleet-chunk loop."""
+    bench = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+    up = state_bytes_per_group(bench)
+    pk = state_bytes_per_group(bench, packed=True)
+    assert pk <= 1300, f"packed state grew to {pk} B/group"
+    assert up / pk >= 2.2, f"state diet ratio fell to {up / pk:.2f}"
+
+    wire_dense = inbox_bytes_per_group(bench, wire_int16=True)
+    wire_compact = inbox_bytes_per_group(bench, wire_int16=True,
+                                         compact_bound=bench.M - 1)
+    assert wire_compact <= 700, f"compact wire grew to {wire_compact}"
+
+    # the headline: total resident bytes/group, diet vs the dense int16
+    # fleet (PROFILE.md round-5 census form)
+    dense_total = up + wire_dense
+    diet_total = pk + wire_compact
+    assert dense_total / diet_total >= 2.0, (
+        f"fleet diet ratio fell below 2x: {dense_total}/{diet_total}")

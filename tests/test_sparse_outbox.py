@@ -1,0 +1,159 @@
+"""RaftConfig.sparse_outbox: the dense outbox leaves the scan carry.
+
+This completes PROFILE.md's emission restructure: under the steady
+message classes every in-scan handler records PendingWire intents, so
+the message scan carries only (NodeState, PendingWire) and the K-slot
+outbox is packed ONCE by the post-scan merge. The equivalence contract
+mirrors tests/test_deferred_emit.py: on live steady traffic the sparse
+program reproduces the immediate-emission steady program bit-for-bit in
+both fleet state and the wire — and the full diet stack (sparse outbox
++ packed state + compacted wire) holds the same bar.
+"""
+import dataclasses
+
+import numpy as np
+import jax
+import pytest
+
+from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
+from etcd_tpu.models.state import pack_fleet, unpack_fleet
+from etcd_tpu.types import (
+    ENTRY_NORMAL,
+    MSG_APP,
+    MSG_APP_RESP,
+    MSG_HEARTBEAT,
+    MSG_PROP,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import RaftConfig
+
+SPEC = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+FULL = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                  inbox_bound=4, coalesce_commit_refresh=True)
+STEADY = dataclasses.replace(
+    FULL, local_steps=("prop",),
+    message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP))
+SPARSE = dataclasses.replace(STEADY, deferred_emit=True, sparse_outbox=True)
+DIET = dataclasses.replace(SPARSE, compact_wire=True, packed_state=True)
+C = 4
+
+
+def test_sparse_outbox_requires_deferred_emit():
+    with pytest.raises(ValueError, match="deferred_emit"):
+        dataclasses.replace(STEADY, sparse_outbox=True)
+
+
+def test_sparse_outbox_requires_steady_classes():
+    """Any class with an in-scan emit site must be rejected — its writes
+    would be silently discarded from the carried PendingWire."""
+    with pytest.raises(ValueError, match="message_classes"):
+        dataclasses.replace(
+            FULL, local_steps=("prop",), deferred_emit=True,
+            sparse_outbox=True,
+            message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP,
+                             MSG_HEARTBEAT))
+    with pytest.raises(ValueError, match="message_classes"):
+        dataclasses.replace(FULL, deferred_emit=True, sparse_outbox=True)
+
+
+def test_compact_wire_requires_inbox_bound():
+    with pytest.raises(ValueError, match="inbox_bound"):
+        RaftConfig(compact_wire=True)
+
+
+@pytest.fixture(scope="module")
+def elected():
+    full = jax.jit(build_round(FULL, SPEC))
+    M, E = SPEC.M, SPEC.E
+    state = init_fleet(SPEC, C, seed=0, election_tick=FULL.election_tick)
+    inbox = empty_inbox(SPEC, C)
+    z2 = np.zeros((M, C), np.int32)
+    zp = np.zeros((M, E, C), np.int32)
+    no = np.zeros((M, C), bool)
+    keep = np.ones((M, M, C), bool)
+    hup = no.copy()
+    hup[0, :] = True
+    state, inbox = full(state, inbox, z2, zp, zp, z2, hup, no, keep)
+    for _ in range(12):
+        state, inbox = full(state, inbox, z2, zp, zp, z2, no, no, keep)
+    assert (np.asarray(state.role)[0] == ROLE_LEADER).all()
+    # quiescent entry point: the diet program boots from an EMPTY compact
+    # inbox, so the comparison must start with no in-flight messages
+    assert int((np.asarray(inbox.type) != 0).sum()) == 0
+    return state, inbox, (z2, zp, no, keep)
+
+
+def _props(z2, zp):
+    plen = z2.copy()
+    plen[0, :] = 1
+    pdata = zp.copy()
+    pdata[0, 0, :] = 7
+    ptype = zp.copy()
+    ptype[0, 0, :] = ENTRY_NORMAL
+    return plen, pdata, ptype
+
+
+def test_sparse_program_is_bit_identical_in_steady_state(elected):
+    """Sparse (carry-free) vs immediate emission: state AND wire equal
+    over 10 live replicating rounds."""
+    steady = jax.jit(build_round(STEADY, SPEC))
+    sparse = jax.jit(build_round(SPARSE, SPEC))
+    state0, inbox0, (z2, zp, no, keep) = elected
+    plen, pdata, ptype = _props(z2, zp)
+
+    sa, ia = state0, inbox0
+    sb, ib = state0, inbox0
+    for _ in range(10):
+        sa, ia = steady(sa, ia, plen, pdata, ptype, z2, no, no, keep)
+        sb, ib = sparse(sb, ib, plen, pdata, ptype, z2, no, no, keep)
+    assert int(np.asarray(sa.commit).min()) >= 8  # really replicating
+    for name in sa.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(sa, name)), np.asarray(getattr(sb, name))
+        ), f"state.{name}"
+    for name in ia.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(ia, name)), np.asarray(getattr(ib, name))
+        ), f"inbox.{name}"
+
+
+def test_full_diet_program_is_bit_identical_in_steady_state(elected):
+    """The whole stack at once — sparse outbox + packed state + compacted
+    int16-free wire — against the immediate-emission steady program."""
+    steady = jax.jit(build_round(STEADY, SPEC))
+    diet = jax.jit(build_round(DIET, SPEC))
+    state0, _, (z2, zp, no, keep) = elected
+    plen, pdata, ptype = _props(z2, zp)
+
+    sa = state0
+    ia = empty_inbox(SPEC, C)
+    pb = pack_fleet(SPEC, state0)
+    ib = empty_inbox(SPEC, C, compact_bound=DIET.inbox_bound)
+    for _ in range(10):
+        sa, ia = steady(sa, ia, plen, pdata, ptype, z2, no, no, keep)
+        pb, ib = diet(pb, ib, plen, pdata, ptype, z2, no, no, keep)
+    sb = unpack_fleet(SPEC, pb)
+    assert int(np.asarray(sa.commit).min()) >= 8
+    for name in sa.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(sa, name)), np.asarray(getattr(sb, name))
+        ), f"state.{name}"
+
+
+def test_sparse_program_heals_a_dropped_append(elected):
+    """Past bit-exactness: the sparse program still converges when a
+    follower's inbound append is dropped for a round (reject/probe
+    path), like the deferred program it specializes."""
+    sparse = jax.jit(build_round(SPARSE, SPEC))
+    state, inbox, (z2, zp, no, keep) = elected
+    plen, pdata, ptype = _props(z2, zp)
+
+    drop = keep.copy()
+    drop[:, 2, :] = False  # member 2 receives nothing this round
+    state, inbox = sparse(state, inbox, plen, pdata, ptype, z2, no, no,
+                          drop)
+    for _ in range(6):
+        state, inbox = sparse(state, inbox, z2, zp, zp, z2, no, no, keep)
+    commits = np.asarray(state.commit)
+    assert (commits[2] == commits[0]).all()  # the dropped member caught up
