@@ -45,6 +45,12 @@ Headline-bench knobs (all validated the same way, exit 2 on bad values):
                 BENCH_r09.json — 2.49x lower bytes/group, chunk-free
                 1.14x over the 8-way chunked form at C=131072)
   BENCH_DEFERRED / BENCH_CC  round-4/5 specialization A/B toggles
+  TELEM         telemetry plane in the observability pass (default 1):
+                the report gains commit-latency p50/p99 (rounds), the
+                full latency histograms, and a measured
+                telemetry_overhead_pct (telemetered round vs bare round
+                at the same shape — PROFILE.md round 7)
+  TELEM_BUCKETS power-of-two histogram buckets (default 8, 2..16)
 The report carries the measured footprint: bytes/group from the actual
 leaf dtypes/shapes of the timed carries, the dense-form baseline and
 their ratio, plus jax.live_arrays() and peak-RSS readings.
@@ -294,6 +300,11 @@ def main() -> None:
     inner = env_int("bench", "BENCH_ROUNDS",
                     str(16 if on_accel else 8), lo=1)
     reps = env_int("bench", "BENCH_REPS", str(3 if on_accel else 2), lo=1)
+    # telemetry plane in the observability pass (models/telemetry.py):
+    # latency histograms + p50/p99 next to throughput, plus the measured
+    # overhead probe. Same exit-2 contract as every other knob.
+    telem = env_bool("bench", "TELEM", "1")
+    telem_buckets = env_int("bench", "TELEM_BUCKETS", "8", 2, 16)
 
     # K=2 message slots: in the no-tick steady state each follower sees one
     # MsgApp per round (appends double as heartbeats, exactly the
@@ -501,25 +512,72 @@ def main() -> None:
         if mesh is not None:
             state = shard_fleet(mesh, state)
 
-    # observability pass: a few metered rounds (fused counters; see
-    # etcd_tpu/models/metrics.py) so the report carries election/lag stats
+    # observability pass: a few metered rounds (fused counters +, with
+    # TELEM=1, the telemetry plane's latency histograms; see
+    # etcd_tpu/models/metrics.py and etcd_tpu/models/telemetry.py) so the
+    # report carries election/lag stats and commit-latency percentiles
     from etcd_tpu.models.metrics import (
         build_metered_round,
         metrics_report,
         zero_metrics,
     )
-    met_step = jax.jit(build_metered_round(cfg, spec),
+    from etcd_tpu.models.telemetry import init_telemetry, telemetry_report
+
+    met_step = jax.jit(build_metered_round(cfg, spec, with_telemetry=telem),
                        donate_argnums=(0, 1))
     metrics = zero_metrics()
+    tele = init_telemetry(spec, state, buckets=telem_buckets) if telem \
+        else None
     mrounds = 8
+    # `args` is the timed loop's operand tuple — reusing it keeps the
+    # overhead probe's bare-round inputs identical to the metered ones
+
+    def met_round():
+        nonlocal state, inbox, metrics, tele
+        if telem:
+            state, inbox, metrics, tele = met_step(
+                state, inbox, *args, metrics, tele)
+        else:
+            state, inbox, metrics = met_step(state, inbox, *args, metrics)
+
+    met_round()  # compile + warm
+    jax.block_until_ready(metrics.commits)
+    # re-zero so the counters cover exactly the timed window (the warm
+    # round would otherwise inflate the derived rates by 9/8); the
+    # telemetry carry stays cumulative — its report derives no rates
+    metrics = zero_metrics()
     t0 = time.perf_counter()
     for _ in range(mrounds):
-        state, inbox, metrics = met_step(
-            state, inbox, prop_len, prop_data, zp, z2, no_hup, no_tick,
-            keep, metrics,
-        )
+        met_round()
     jax.block_until_ready(metrics.commits)
-    rep = metrics_report(metrics, time.perf_counter() - t0, C, spec.M)
+    t_obs = time.perf_counter() - t0
+    rep = metrics_report(metrics, t_obs, C, spec.M)
+    telemetry_extra = {}
+    if telem:
+        trep = telemetry_report(tele)
+        # telemetry overhead probe: the same mrounds through the BARE
+        # round program (already compiled by the settle phase). The
+        # delta covers the WHOLE observability pass (FleetMetrics
+        # counters + telemetry), so it is an UPPER BOUND on the
+        # telemetry reductions' own cost — conservative against the
+        # <= 10% acceptance bar without compiling a third
+        # (metrics-only) program into every bench run
+        state, inbox = step(state, inbox, *args)   # warm/settle dispatch
+        jax.block_until_ready(jax.tree.leaves(state)[0])
+        t0 = time.perf_counter()
+        for _ in range(mrounds):
+            state, inbox = step(state, inbox, *args)
+        jax.block_until_ready(jax.tree.leaves(state)[0])
+        t_bare = time.perf_counter() - t0
+        telemetry_extra = {
+            "commit_latency_p50_rounds":
+                trep["commit_latency_rounds"]["p50"],
+            "commit_latency_p99_rounds":
+                trep["commit_latency_rounds"]["p99"],
+            "telemetry_overhead_pct": round(
+                (t_obs - t_bare) / t_bare * 100, 1),
+            "telemetry": trep,
+        }
 
     # -- resident-footprint accounting (the fleet memory diet's measured
     # side): bytes/group from the ACTUAL leaf dtypes/shapes of the timed
@@ -582,6 +640,7 @@ def main() -> None:
                 ],
                 "commit_apply_lag_hist": rep["commit_apply_lag_hist"],
                 "msgs_dropped": rep["msgs_dropped"],
+                **telemetry_extra,
                 **footprint,
             }
         )

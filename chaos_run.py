@@ -32,9 +32,19 @@ harness the fuzz suite drives) after the chaos run and folds a
   APPLY_KEYS=64 APPLY_GROUPS=256 APPLY_OPS=200 python chaos_run.py
 (APPLY_KEYS=0, the default, skips the tier.)
 
+Telemetry / flight recorder (ISSUE 9): TELEM=1 (the default) rides the
+FleetTelemetry plane (etcd_tpu/models/telemetry.py) through every epoch
+and folds a per-epoch ``timeline`` array (cumulative latency histograms
++ lane totals + violation/crash counters at each epoch boundary) plus a
+``telemetry`` summary (p50/p99 propose→commit, election and heal
+latencies) into the JSON line — a failing soak is diagnosable post-hoc
+epoch by epoch. TELEM=0 disables (bit-identical state trajectory);
+TELEM_BUCKETS sets the power-of-two histogram bucket count (2..16).
+
 All knobs are validated up front: a probability outside [0, 1], a boost
-below 1, an unknown mix/durability name, or an out-of-range APPLY_*
-value exits 2 before any device work.
+below 1, an unknown mix/durability name, a TELEM value that is not 0/1,
+or an out-of-range APPLY_*/TELEM_BUCKETS value exits 2 before any
+device work.
 """
 from __future__ import annotations
 
@@ -48,13 +58,14 @@ import jax
 
 import functools
 
-from etcd_tpu.utils.knobs import env_float, env_int, knob_error
+from etcd_tpu.utils.knobs import env_bool, env_float, env_int, knob_error
 
 # the shared exit-2-before-device-work validation pattern
 # (etcd_tpu/utils/knobs.py), bound to this driver's name
 _knob_error = functools.partial(knob_error, "chaos_run")
 _env_float = functools.partial(env_float, "chaos_run")
 _env_int = functools.partial(env_int, "chaos_run")
+_env_bool = functools.partial(env_bool, "chaos_run")
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
@@ -129,6 +140,10 @@ def main() -> int:
                                       ("APPLY_GROUPS", "256", 1, None),
                                       ("APPLY_OPS", "200", 1, None))
     }
+    # telemetry plane / flight recorder (models/telemetry.py): on by
+    # default — the timeline costs one tiny host transfer per epoch
+    telem = _env_bool("TELEM", "1")
+    telem_buckets = _env_int("TELEM_BUCKETS", "8", 2, 16)
 
     env_w16 = os.environ.get("CHAOS_WIRE16")
     if member_p > 0 and env_w16 is not None and env_w16 != "0":
@@ -189,6 +204,7 @@ def main() -> int:
         member_p=member_p, member=member_cfg,
         config_aware=os.environ.get("CHAOS_CONFIG_AWARE", "1") != "0",
         sync_dispatch=os.environ.get("CHAOS_SYNC", "0") != "0",
+        telemetry=telem, telemetry_buckets=telem_buckets,
     )
     rep["elapsed_s"] = round(time.perf_counter() - t0, 1)
     rep["platform"] = platform

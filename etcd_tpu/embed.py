@@ -70,6 +70,11 @@ class Config:
     # fsync-before-ack durability barrier. Faster, loses acknowledged
     # writes on kill -9.
     unsafe_no_fsync: bool = False
+    # --metrics extensive analog: attach the fleet telemetry plane
+    # (models/telemetry.py) so /metrics serves latency-histogram
+    # families (commit latency, election duration) beside the gauges.
+    # One extra small fused dispatch per raft step.
+    telemetry: bool = False
 
     def validate(self) -> None:
         if self.cluster_size < 1:
@@ -213,11 +218,13 @@ class Etcd:
                 )
                 return EtcdCluster.boot_from_disk(
                     cfg.data_dir, n_members=1, members=[src],
-                    cluster=Cluster(n_members=1, cfg=raft_cfg), **kw,
+                    cluster=Cluster(n_members=1, cfg=raft_cfg,
+                        telemetry=cfg.telemetry), **kw,
                 )
             return EtcdCluster.boot_from_disk(
                 cfg.data_dir, n_members=n, missing_ok=True, uniform=False,
-                cluster=Cluster(n_members=n, cfg=raft_cfg), **kw,
+                cluster=Cluster(n_members=n, cfg=raft_cfg,
+                        telemetry=cfg.telemetry), **kw,
             )
         if cfg.initial_cluster_state == "existing":
             # bootstrapExistingClusterNoWAL (bootstrap.go:182) fails the
@@ -228,7 +235,8 @@ class Etcd:
             )
         return EtcdCluster(
             n_members=n,
-            cluster=Cluster(n_members=n, cfg=raft_cfg),
+            cluster=Cluster(n_members=n, cfg=raft_cfg,
+                        telemetry=cfg.telemetry),
             data_dir=cfg.data_dir,
             **kw,
         )

@@ -91,6 +91,13 @@ from etcd_tpu.models.metrics import (
     zero_crash_metrics,
 )
 from etcd_tpu.models.state import NodeState
+from etcd_tpu.models.telemetry import (
+    DEFAULT_BUCKETS,
+    flight_record,
+    init_telemetry,
+    telemetry_report,
+    telemetry_update,
+)
 from etcd_tpu.types import (
     CC_ADD_LEARNER,
     CC_ADD_NODE,
@@ -515,14 +522,15 @@ def build_chaos_epoch(
     with_delay: bool = True,
     with_crash: bool = False,
     with_member: bool = False,
+    with_telemetry: bool = False,
 ):
     """One jitted chaos epoch: `rounds` lockstep rounds of faulted traffic
     with per-round invariant checks.
 
     Returns fn(state, inbox, held, crash, key, prop_len, prop_data, viol,
-    drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log,
+    tele, drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log,
     config_aware, member_p, palette, snap_boost, member_boost) ->
-    (state, inbox, held, crash, key, viol, commits_delta). The fault
+    (state, inbox, held, crash, key, viol, tele, commits_delta). The fault
     probabilities are RUNTIME operands, not closure constants — one
     traced program serves every fault mix (a full trace costs ~40s of
     single-core time; the suite's chaos configurations used to pay it
@@ -563,6 +571,16 @@ def build_chaos_epoch(
     to completion and keeps checking the recovery invariants; only fault
     epochs sample new crashes.
 
+    `with_telemetry` rides a FleetTelemetry carry (models/telemetry.py)
+    through every round — per-group lanes + latency histograms updated
+    by the same read-only reductions as the checkers, so the state
+    trajectory with telemetry on is BIT-IDENTICAL to the trajectory
+    with it off (tests/test_telemetry.py proves it against this very
+    program). Off, callers pass tele=None and get None back, and the
+    traced program is structurally unchanged. The restart/down masks of
+    the crash machinery feed the heal-latency histogram; without
+    crashes those reduce to carry passthrough at trace time.
+
     `with_member` adds the membership-change fault class to fault epochs:
     node 0's per-round proposal becomes an encoded conf-change word with
     probability ``member_p``, sampled from the i32[P] ``palette`` operand
@@ -587,8 +605,9 @@ def build_chaos_epoch(
     with_recovery = with_crash or with_member
 
     def epoch(state, inbox, held, crash, key, prop_len, prop_data, viol,
-              drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log,
-              config_aware, member_p, palette, snap_boost, member_boost):
+              tele, drop_p, delay_p, partition_p, crash_p, down_rounds,
+              keep_log, config_aware, member_p, palette, snap_boost,
+              member_boost):
         prev_commit = state.commit
         C = state.term.shape[-1]
         zp = jnp.zeros((M, spec.E, C), jnp.int32)
@@ -602,14 +621,16 @@ def build_chaos_epoch(
             """Top-of-round crash bookkeeping: run down-timers, optionally
             kill fresh nodes (volatile-state wipe to the durable floor),
             silence all down hosts' in-flight traffic, refresh the floor.
-            Returns (..., crashed_now, alive); no-op when crashes are
-            compiled out (a member-only program passes its CrashState
-            carry through untouched — only post_checks updates it)."""
+            Returns (..., crashed_now, alive, restarted_mask); no-op when
+            crashes are compiled out (a member-only program passes its
+            CrashState carry through untouched — only post_checks updates
+            it)."""
             if not with_crash:
-                return state, inbox, held, crash, key, None, None
+                return state, inbox, held, crash, key, None, None, None
             was_down = crash.down > 0
             down = jnp.maximum(crash.down - 1, 0)
-            restarted = (was_down & (down == 0)).sum().astype(jnp.int32)
+            restarted_mask = was_down & (down == 0)      # [M, C]
+            restarted = restarted_mask.sum().astype(jnp.int32)
             if sample:
                 key, ck, tk = jax.random.split(key, 3)
                 # targeted scheduling: concentrate the SAME expected
@@ -668,7 +689,8 @@ def build_chaos_epoch(
                     restarts_completed=m.restarts_completed + restarted,
                 ),
             )
-            return state, inbox, held, crash, key, hit, down == 0
+            return (state, inbox, held, crash, key, hit, down == 0,
+                    restarted_mask)
 
         def mask_down(keep, pl, dt, alive):
             """Down nodes neither exchange traffic, tick, nor propose."""
@@ -743,6 +765,17 @@ def build_chaos_epoch(
                     spec, state, crash, viol, config_aware)
             return crash, viol
 
+        def tele_step(tele, pre, state, alive, restarted):
+            """Telemetry pass (read-only; compiled out when off). ``pre``
+            is the post-wipe pre-round state, so a crash rewind never
+            reads as a role/applied transition."""
+            if not with_telemetry:
+                return tele
+            return telemetry_update(
+                spec, tele, pre, state,
+                restarted=restarted,
+                down=None if alive is None else ~alive)
+
         if faultless:
             # heal program: no fault sampling, no delay bookkeeping. Drain
             # whatever the previous chaos epoch still held by merging it
@@ -757,8 +790,8 @@ def build_chaos_epoch(
             keep_all = jnp.ones((M, M, C), jnp.bool_)
 
             def heal_body(carry, r):
-                state, inbox, crash, viol, prev_commit = carry
-                state, inbox, _, crash, _, hit, alive = pre_round(
+                state, inbox, crash, viol, tele, prev_commit = carry
+                state, inbox, _, crash, _, hit, alive, rst = pre_round(
                     state, inbox, None, crash, None, False)
                 pre = state
                 keep, pl, dt = mask_down(keep_all, prop_len, do_tick, alive)
@@ -767,13 +800,14 @@ def build_chaos_epoch(
                 )
                 crash, viol = post_checks(pre, state, prev_commit, crash,
                                           viol, hit)
-                return (state, out, crash, viol, state.commit), None
+                tele = tele_step(tele, pre, state, alive, rst)
+                return (state, out, crash, viol, tele, state.commit), None
 
-            (state, inbox, crash, viol, prev_commit), _ = jax.lax.scan(
-                heal_body, (state, inbox, crash, viol, prev_commit),
+            (state, inbox, crash, viol, tele, prev_commit), _ = jax.lax.scan(
+                heal_body, (state, inbox, crash, viol, tele, prev_commit),
                 jnp.arange(rounds, dtype=jnp.int32),
             )
-            return (state, inbox, held, crash, key, viol,
+            return (state, inbox, held, crash, key, viol, tele,
                     state.commit.sum() - commit0)
 
         def sample_keep(key, r):
@@ -794,8 +828,9 @@ def build_chaos_epoch(
 
         if with_delay:
             def body(carry, r):
-                state, inbox, held, crash, key, viol, prev_commit = carry
-                state, inbox, held, crash, key, hit, alive = pre_round(
+                state, inbox, held, crash, key, viol, tele, prev_commit = \
+                    carry
+                state, inbox, held, crash, key, hit, alive, rst = pre_round(
                     state, inbox, held, crash, key, True)
                 pre = state
                 if with_member:
@@ -814,19 +849,21 @@ def build_chaos_epoch(
                 nxt, held2 = _merge_delayed(spec, out, held, delay)
                 crash, viol = post_checks(pre, state, prev_commit, crash,
                                           viol, hit)
-                return (state, nxt, held2, crash, key, viol,
+                tele = tele_step(tele, pre, state, alive, rst)
+                return (state, nxt, held2, crash, key, viol, tele,
                         state.commit), None
 
-            (state, inbox, held, crash, key, viol, prev_commit), _ = \
+            (state, inbox, held, crash, key, viol, tele, prev_commit), _ = \
                 jax.lax.scan(
                     body,
-                    (state, inbox, held, crash, key, viol, prev_commit),
+                    (state, inbox, held, crash, key, viol, tele,
+                     prev_commit),
                     jnp.arange(rounds, dtype=jnp.int32),
                 )
         else:
             def body(carry, r):
-                state, inbox, crash, key, viol, prev_commit = carry
-                state, inbox, _, crash, key, hit, alive = pre_round(
+                state, inbox, crash, key, viol, tele, prev_commit = carry
+                state, inbox, _, crash, key, hit, alive, rst = pre_round(
                     state, inbox, None, crash, key, True)
                 pre = state
                 if with_member:
@@ -841,13 +878,17 @@ def build_chaos_epoch(
                 )
                 crash, viol = post_checks(pre, state, prev_commit, crash,
                                           viol, hit)
-                return (state, out, crash, key, viol, state.commit), None
+                tele = tele_step(tele, pre, state, alive, rst)
+                return (state, out, crash, key, viol, tele,
+                        state.commit), None
 
-            (state, inbox, crash, key, viol, prev_commit), _ = jax.lax.scan(
-                body, (state, inbox, crash, key, viol, prev_commit),
-                jnp.arange(rounds, dtype=jnp.int32),
-            )
-        return state, inbox, held, crash, key, viol, \
+            (state, inbox, crash, key, viol, tele, prev_commit), _ = \
+                jax.lax.scan(
+                    body, (state, inbox, crash, key, viol, tele,
+                           prev_commit),
+                    jnp.arange(rounds, dtype=jnp.int32),
+                )
+        return state, inbox, held, crash, key, viol, tele, \
             state.commit.sum() - commit0
 
     return epoch
@@ -856,7 +897,8 @@ def build_chaos_epoch(
 @functools.lru_cache(maxsize=32)
 def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
                    faultless: bool, with_delay: bool = True,
-                   with_crash: bool = False, with_member: bool = False):
+                   with_crash: bool = False, with_member: bool = False,
+                   with_telemetry: bool = False):
     """One jitted epoch program per (cfg, spec, rounds, structure),
     shared across every run_chaos call and fault mix (probabilities are
     operands). Donation of the fleet-sized carries (state/inbox/held) is
@@ -878,12 +920,22 @@ def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
         # the tunneled TPU worker at fleet scale. CrashState (arg 3) is
         # a few [M, C] planes — not worth the same None-donation hazard.
         donate = (0, 1, 2) if with_delay else (0, 1)
+        if with_telemetry:
+            # the telemetry carry (arg 8) holds fleet-scaled leaves
+            # (birth_ring [L, C], cand_since/heal_since [M, C]) and is
+            # exclusively threaded — the pre-call pytree is dead once
+            # the epoch returns (flight_record reads the returned one),
+            # so it joins the anti-double-buffering list. Only when the
+            # plane is on: tele=None is the same None-donation hazard
+            # as held.
+            donate = donate + (8,)
     else:
         donate = ()
     return jax.jit(
         build_chaos_epoch(cfg, spec, rounds, faultless=faultless,
                           with_delay=with_delay, with_crash=with_crash,
-                          with_member=with_member),
+                          with_member=with_member,
+                          with_telemetry=with_telemetry),
         donate_argnums=donate,
     )
 
@@ -906,6 +958,8 @@ def run_chaos(
     config_aware: bool = True,
     propose: bool = True,
     sync_dispatch: bool = False,
+    telemetry: bool = False,
+    telemetry_buckets: int = DEFAULT_BUCKETS,
 ) -> dict:
     """The tester's round loop (tester/cluster_run.go): alternate fault
     epochs and heal epochs, then verify recovery — every group ends with
@@ -927,6 +981,15 @@ def run_chaos(
     selects the deliberately-broken config-blind recovery checkers (a
     runtime operand — it shares the traced programs with the honest
     mode, like the persist-nothing durability knob).
+
+    ``telemetry=True`` rides the FleetTelemetry plane through every
+    epoch and turns the run into a FLIGHT RECORDER: the report gains a
+    ``timeline`` array with one row per epoch (cumulative latency
+    histograms + per-group lane totals + violation/crash counters at
+    that epoch boundary — telemetry.flight_record) and a ``telemetry``
+    summary with p50/p99 latencies, so a failing soak is diagnosable
+    post-hoc epoch by epoch instead of from one end-state blob. State
+    trajectories are bit-identical with telemetry on or off.
     """
     with_crash = crash_p > 0
     with_member = member_p > 0
@@ -981,10 +1044,12 @@ def run_chaos(
         prop_len = prop_len.at[0].set(1)
         prop_data = prop_data.at[0, 0].set(7)
 
+    tele = (init_telemetry(spec, state, buckets=telemetry_buckets)
+            if telemetry else None)
     chaos = _epoch_program(cfg, spec, epoch_len, False, with_delay,
-                           with_crash, with_member)
+                           with_crash, with_member, telemetry)
     heal = _epoch_program(cfg, spec, heal_len, True, with_delay, with_crash,
-                          with_member)
+                          with_member, telemetry)
     dp = jnp.float32(drop_p)
     lp = jnp.float32(delay_p)
     pp = jnp.float32(partition_p)
@@ -1009,22 +1074,35 @@ def run_chaos(
 
     viol = zero_violations()
     commits = []
+    timeline = []
+
+    def record(kind):
+        # one small host transfer per epoch boundary: the flight
+        # recorder's cumulative snapshot (never inside the scan)
+        if telemetry:
+            timeline.append(flight_record(
+                tele, viol,
+                crash_state.metrics if with_recovery else None,
+                kind=kind))
+
     done = 0
     fault_rounds = 0
     while done < rounds:
-        state, inbox, held, crash_state, key, viol, dc = chaos(
+        state, inbox, held, crash_state, key, viol, tele, dc = chaos(
             state, inbox, held, crash_state, key, prop_len, prop_data, viol,
-            dp, lp, pp, cp, dr, kl, ca, mp, palette, sb, mb
+            tele, dp, lp, pp, cp, dr, kl, ca, mp, palette, sb, mb
         )
         _sync(viol.multi_leader)
         done += epoch_len
         fault_rounds += epoch_len
-        state, inbox, held, crash_state, key, viol, dh = heal(
+        record("fault")
+        state, inbox, held, crash_state, key, viol, tele, dh = heal(
             state, inbox, held, crash_state, key, prop_len, prop_data, viol,
-            z, z, z, z, dr, kl, ca, z, palette, sb, mb
+            tele, z, z, z, z, dr, kl, ca, z, palette, sb, mb
         )
         _sync(viol.multi_leader)
         done += heal_len
+        record("heal")
         commits.append((int(dc), int(dh)))
 
     # recovery check (the tester's WaitHealth loop, tester/cluster.go):
@@ -1037,11 +1115,12 @@ def run_chaos(
     for _ in range(6):
         if leaders() == C:
             break
-        state, inbox, held, crash_state, key, viol, dh = heal(
+        state, inbox, held, crash_state, key, viol, tele, dh = heal(
             state, inbox, held, crash_state, key, prop_len, prop_data, viol,
-            z, z, z, z, dr, kl, ca, z, palette, sb, mb
+            tele, z, z, z, z, dr, kl, ca, z, palette, sb, mb
         )
         done += heal_len
+        record("heal")
         commits.append((0, int(dh)))
     has_leader = leaders()
     v = jax.device_get(viol)
@@ -1068,6 +1147,17 @@ def run_chaos(
         rep["member_p"] = member_p
         rep["member_mix"] = member_cfg.mix
         rep["initial_voters"] = member_cfg.initial_voters
+    if telemetry:
+        try:
+            rep["telemetry"] = telemetry_report(tele)
+        except OverflowError:
+            # an i32 counter wrapped (realistic only for very long soaks
+            # at very large C, e.g. commit_sum ~ C*latency per round) —
+            # a multi-hour run must still emit its report; the timeline
+            # rows carry per-row `wrapped` flags for the same reason
+            rep["telemetry"] = {"wrapped": True,
+                                "rounds": int(jax.device_get(tele.round))}
+        rep["timeline"] = timeline
     if with_recovery:
         rep["config_aware"] = config_aware
         rep.update(crash_metrics_report(crash_state.metrics))

@@ -14,12 +14,24 @@ index ``leaf[m, ..., c]``.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from etcd_tpu.models.engine import RaftEngine
 from etcd_tpu.types import ENTRY_CONF_CHANGE, ENTRY_NORMAL, NONE_ID, ROLE_LEADER, Spec
 from etcd_tpu.utils.config import RaftConfig
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_tele_update(spec: Spec):
+    """One jitted telemetry pass per Spec, shared by every Cluster —
+    same tracing-cost rationale as engine._jitted_round."""
+    from etcd_tpu.models.telemetry import telemetry_update
+
+    return jax.jit(functools.partial(telemetry_update, spec))
 
 
 class Cluster:
@@ -32,6 +44,7 @@ class Cluster:
         voters=None,
         learners=None,
         seed: int = 0,
+        telemetry: bool = False,
     ):
         spec = spec or Spec(M=n_members)
         # canonical lane padding: each distinct C value re-traces the whole
@@ -59,6 +72,25 @@ class Cluster:
                 )
         self.eng = RaftEngine(spec, cfg, self._Cp, voters, learners, seed)
         self.spec, self.cfg = spec, cfg
+        # opt-in telemetry plane (models/telemetry.py): per-group lanes +
+        # latency histograms updated beside each step — the serving
+        # layer's /metrics histogram source. Read-only over state, so a
+        # telemetered Cluster steps bit-identically; padding lanes are
+        # sliced off at report time (telemetry_report(groups=self.C)).
+        self.tele = None
+        if telemetry:
+            if cfg.packed_state:
+                # init/update read NodeState leaves off the live engine
+                # state; the packed storage form would die with an
+                # opaque AttributeError (same restriction class as
+                # engine.build_kv_round's guard)
+                raise ValueError(
+                    "Cluster telemetry reads the unpacked fleet; "
+                    "construct with packed_state=False")
+            from etcd_tpu.models.telemetry import init_telemetry
+
+            self.tele = init_telemetry(spec, self.eng.state)
+            self._tele_step = _jitted_tele_update(spec)
         self._next_ctx = 1
         self._reset_inputs()
 
@@ -137,6 +169,7 @@ class Cluster:
         do_tick = np.zeros((self.spec.M, self._Cp), bool)
         if tick:
             do_tick[:, : self.C] = True
+        pre = self.eng.state if self.tele is not None else None
         self.eng.step(
             prop_len=self._plen,
             prop_data=self._pdata,
@@ -145,7 +178,18 @@ class Cluster:
             do_hup=self._hup,
             do_tick=do_tick,
         )
+        if self.tele is not None:
+            self.tele = self._tele_step(self.tele, pre, self.eng.state)
         self._reset_inputs()
+
+    def reset_telemetry(self) -> None:
+        """Open a fresh telemetry measurement window. The counters are
+        i32 and meant to be reset per window (FleetTelemetry docstring);
+        the serving layer calls this when a scrape detects a wrap."""
+        if self.tele is not None:
+            from etcd_tpu.models.telemetry import init_telemetry
+
+            self.tele = init_telemetry(self.spec, self.eng.state)
 
     def tick(self, rounds: int = 1):
         for _ in range(rounds):

@@ -23,7 +23,7 @@ import numpy as np
 from flax import struct
 
 from etcd_tpu.models.engine import build_round
-from etcd_tpu.models.state import NodeState
+from etcd_tpu.models.state import NodeState, unpack_fleet
 from etcd_tpu.types import (
     NONE_ID,
     PR_PROBE,
@@ -137,40 +137,51 @@ def crash_metrics_report(m: CrashMetrics) -> dict:
     return out
 
 
-def build_metered_round(cfg: RaftConfig, spec: Spec):
-    """Round program with fused metric updates.
+def build_metered_round(cfg: RaftConfig, spec: Spec,
+                        with_telemetry: bool = False):
+    """Round program with fused metric (and optional telemetry) updates
+    — the ONE instrumented-round builder every observability consumer
+    shares (ISSUE 9 unification).
 
     Returns fn(state, inbox, prop_len, prop_data, prop_type, ri_ctx,
-    do_hup, do_tick, keep_mask, metrics) -> (state, inbox, metrics).
+    do_hup, do_tick, keep_mask, metrics) -> (state, inbox, metrics);
+    with_telemetry adds a trailing FleetTelemetry argument and result
+    (models/telemetry.py — per-group lanes + latency histograms), fused
+    into the same program by the same read-only reductions.
 
     The metric math is a handful of elementwise reductions over state
     the round already touches — XLA fuses them into the same program, so
-    the marginal cost is one small add per counter. The compacted wire
-    carry (RaftConfig.compact_wire) composes fine — `delivered` then
-    counts post-compaction slots, i.e. messages that can still be
-    consumed; packed_state does not (the counters read roles/cursors off
-    the unpacked fleet), so perf drivers unpack at the boundary
-    (bench.py does).
+    the marginal cost is one small add per counter. The whole PR-8 diet
+    composes: under compact_wire `delivered` counts post-compaction
+    slots (messages that can still be consumed), and under packed_state
+    the counters read a read-only UNPACKED VIEW at the round boundary
+    while the carried state stays packed — note the view materializes
+    the dense fleet as a temporary, so metering a fleet_chunks program
+    at huge C pays a full-fleet temp (observability passes run at
+    bounded C or bounded rounds; the timed hot loop stays unmetered).
+    Telemetry only reads, never feeds back: state/inbox out of the
+    metered program are bit-identical to the bare round's
+    (tests/test_telemetry.py).
     """
-    if cfg.packed_state:
-        raise ValueError(
-            "build_metered_round reads the unpacked fleet; unpack at the "
-            "boundary (models/state.py unpack_fleet) and meter with "
-            "packed_state=False")
     round_fn = build_round(cfg, spec, with_drop_count=True)
+    unp = ((lambda s: unpack_fleet(spec, s)) if cfg.packed_state
+           else (lambda s: s))
 
     def metered(state: NodeState, inbox, prop_len, prop_data, prop_type,
-                ri_ctx, do_hup, do_tick, keep_mask, metrics: FleetMetrics):
-        was_leader = state.role == ROLE_LEADER
-        commit0, applied0 = state.commit, state.applied
+                ri_ctx, do_hup, do_tick, keep_mask, metrics: FleetMetrics,
+                telemetry=None):
+        pre = unp(state)
+        was_leader = pre.role == ROLE_LEADER
+        commit0, applied0 = pre.commit, pre.applied
         state, next_inbox, dropped = round_fn(
             state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup,
             do_tick, keep_mask,
         )
-        is_leader = state.role == ROLE_LEADER
+        post = unp(state)
+        is_leader = post.role == ROLE_LEADER
         dt = metrics.rounds.dtype
         delivered = (next_inbox.type != 0).sum().astype(dt)
-        lag = (state.commit - state.applied).astype(jnp.int32)
+        lag = (post.commit - post.applied).astype(jnp.int32)
         edges = jnp.asarray(LAG_BUCKETS, jnp.int32)
         # Prometheus-style cumulative buckets: hist[b] counts lag <=
         # edges[b]; the final slot counts every sample (+inf bucket)
@@ -184,13 +195,18 @@ def build_metered_round(cfg: RaftConfig, spec: Spec):
             leader_losses=metrics.leader_losses
             + (was_leader & ~is_leader).sum().astype(dt),
             commits=metrics.commits
-            + (state.commit - commit0).sum().astype(dt),
+            + (post.commit - commit0).sum().astype(dt),
             applies=metrics.applies
-            + (state.applied - applied0).sum().astype(dt),
+            + (post.applied - applied0).sum().astype(dt),
             msgs_delivered=metrics.msgs_delivered + delivered,
             msgs_dropped=metrics.msgs_dropped + dropped.astype(dt),
             lag_hist=metrics.lag_hist + hist,
         )
+        if with_telemetry:
+            from etcd_tpu.models.telemetry import telemetry_update
+
+            telemetry = telemetry_update(spec, telemetry, pre, post)
+            return state, next_inbox, metrics, telemetry
         return state, next_inbox, metrics
 
     return metered
@@ -252,11 +268,14 @@ def fleet_summary(state: NodeState) -> dict:
         roles = jnp.stack([(s.role == r).sum() for r in range(4)])
         lag = s.commit - s.applied
         per_group_leaders = (s.role == ROLE_LEADER).sum(axis=0)
+        edges = jnp.asarray(LAG_BUCKETS, jnp.int32)
+        lag_cum = (lag[..., None] <= edges).sum(axis=(0, 1))
         return dict(
             roles=roles,
             term_max=s.term.max(),
             commit_min=s.commit.min(), commit_max=s.commit.max(),
-            lag_max=lag.max(), lag_sum=lag.sum(),
+            applied_max=s.applied.max(),
+            lag_max=lag.max(), lag_sum=lag.sum(), lag_cum=lag_cum,
             groups_with_leader=(per_group_leaders > 0).sum(),
             groups_multi_leader=(per_group_leaders > 1).sum(),
         )
@@ -272,8 +291,17 @@ def fleet_summary(state: NodeState) -> dict:
         "term_max": int(r["term_max"]),
         "commit_min": int(r["commit_min"]),
         "commit_max": int(r["commit_max"]),
+        "applied_max": int(r["applied_max"]),
         "commit_apply_lag_max": int(r["lag_max"]),
         "commit_apply_lag_mean": float(r["lag_sum"]) / (M * C),
+        "lag_sum": int(r["lag_sum"]),
+        # instantaneous lag distribution across all fleet nodes at the
+        # scrape instant — the /metrics histogram family's source
+        "commit_apply_lag_hist": {
+            **{f"le_{b}": int(v)
+               for b, v in zip(LAG_BUCKETS, r["lag_cum"])},
+            "inf": M * C,
+        },
         "groups_with_leader": int(r["groups_with_leader"]),
         "groups_multi_leader": int(r["groups_multi_leader"]),
     }

@@ -1,0 +1,568 @@
+"""Device-resident fleet telemetry plane: per-group lanes + latency
+histograms + Prometheus exposition.
+
+The reference instruments everything with Prometheus histograms and
+per-node Status (etcdserver/metrics.go, raft/status.go); FleetMetrics
+(models/metrics.py) gave the fleet scalar counters and one lag
+histogram, but no latency *distributions*, no per-group resolution and
+no time dimension. This module adds the missing substrate:
+
+  * :class:`FleetTelemetry` — a pytree riding BESIDE the fleet state
+    through the traced round: per-group event lanes ``[C]`` (leader
+    changes, snapshot installs, crash-heal rounds) and fused
+    power-of-two-bucket latency histograms for propose→commit round
+    latency, election duration (candidate→leader rounds) and post-crash
+    heal time (restart→caught-up-to-commit-frontier).
+  * :func:`telemetry_update` — ONE pure function of (pre, post) round
+    states; every consumer (the metered round, the chaos epoch scan,
+    the serving-layer Cluster) calls the same math, so the numbers mean
+    the same thing everywhere. Telemetry only READS state — it never
+    feeds back — so a telemetry-on round is bit-identical in state to
+    the telemetry-off round (tests/test_telemetry.py proves it over the
+    rich full-program scenario, including under the PR-8 diet).
+  * host-side reporting: cumulative-bucket dicts, percentile extraction
+    (p50/p99 for bench.py), per-epoch :func:`flight_record` snapshots
+    (the chaos flight recorder's timeline rows), and Prometheus
+    exposition-format render/parse for the ``/metrics`` endpoint.
+
+Propose→commit latency is tracked with a small BIRTH RING ``[L, C]``
+alongside the log cursor: the round each log index first appeared at
+the group's append frontier. When the group commit frontier passes an
+index, ``round - birth`` is bucketed. A suffix truncated and rewritten
+by a new leader keeps the earlier birth (the sample then measures the
+client-visible wait since the index first existed — conservative);
+entries in flight when telemetry attaches sample from the attach round.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from etcd_tpu.models.state import NodeState
+from etcd_tpu.types import (
+    ROLE_CANDIDATE,
+    ROLE_LEADER,
+    ROLE_PRE_CANDIDATE,
+    Spec,
+)
+
+# power-of-two histogram edges 1, 2, 4, ..., 2^(buckets-1) rounds; the
+# final histogram slot is the +Inf bucket (= total sample count), the
+# same cumulative Prometheus convention as metrics.LAG_BUCKETS
+DEFAULT_BUCKETS = 8
+
+
+def pow2_edges(buckets: int) -> tuple:
+    return tuple(1 << i for i in range(buckets))
+
+
+class FleetTelemetry(struct.PyTreeNode):
+    """Device-resident telemetry carry. Counter lanes are i32 and reset
+    per measurement window like FleetMetrics (telemetry_report raises on
+    wrap). The ``birth_ring``/``prev_*``/``*_since`` leaves are tracking
+    carries, not metrics — they exist so transitions can be detected
+    with pure tensor math inside the traced round."""
+
+    round: jnp.ndarray              # i32 rounds observed
+    # per-group event lanes [C]
+    leader_changes: jnp.ndarray     # rounds a new leader emerged
+    snapshot_installs: jnp.ndarray  # MsgSnap installs (applied jump > A)
+    heal_rounds: jnp.ndarray        # rounds with a member down/healing
+    # latency histograms: [buckets+1] cumulative pow2 counts (+Inf last)
+    commit_hist: jnp.ndarray        # propose→commit rounds
+    commit_sum: jnp.ndarray         # i32 sum of samples (Prometheus _sum)
+    elect_hist: jnp.ndarray         # candidate→leader rounds
+    elect_sum: jnp.ndarray
+    heal_hist: jnp.ndarray          # restart→caught-up rounds
+    heal_sum: jnp.ndarray
+    # tracking carries
+    birth_ring: jnp.ndarray         # [L, C] round each index was appended
+    prev_last: jnp.ndarray          # [C] group append frontier last round
+    prev_commit: jnp.ndarray        # [C] running max group commit frontier
+    cand_since: jnp.ndarray         # [M, C] round candidacy began (-1 none)
+    heal_since: jnp.ndarray         # [M, C] round restart completed (-1)
+
+
+def init_telemetry(spec: Spec, state: NodeState,
+                   buckets: int = DEFAULT_BUCKETS) -> FleetTelemetry:
+    """Telemetry attached to a live (unpacked) fleet. The prev_* carries
+    seed from the current frontiers — entries already in flight sample
+    their latency from the attach round (bounded by the pipeline depth).
+    All leaves are freshly computed buffers, never aliases of state
+    leaves (the empty_crash_state donation-alias hazard class)."""
+    if not 2 <= buckets <= 16:
+        raise ValueError(f"telemetry buckets={buckets} outside [2, 16]")
+    C = state.term.shape[-1]
+
+    # every leaf gets its OWN buffer: the chaos epoch programs donate
+    # the whole carry on accelerators, and XLA rejects one buffer at
+    # two donated positions in a single Execute — a shared zeros/scalar
+    # temp across leaves would crash the first donated epoch call
+    # (tests/test_telemetry.py asserts pairwise-distinct leaf buffers)
+    def z():
+        return jnp.zeros((), jnp.int32)
+
+    def zc():
+        return jnp.zeros((C,), jnp.int32)
+
+    def zh():
+        return jnp.zeros((buckets + 1,), jnp.int32)
+
+    def neg():
+        return jnp.full((spec.M, C), -1, jnp.int32)
+
+    return FleetTelemetry(
+        round=z(),
+        leader_changes=zc(), snapshot_installs=zc(), heal_rounds=zc(),
+        commit_hist=zh(), commit_sum=z(),
+        elect_hist=zh(), elect_sum=z(),
+        heal_hist=zh(), heal_sum=z(),
+        birth_ring=jnp.zeros((spec.L, C), jnp.int32),
+        prev_last=state.last_index.max(axis=0),
+        prev_commit=state.commit.max(axis=0),
+        cand_since=neg(), heal_since=neg(),
+    )
+
+
+def _hist_add(hist, total_sum, samples, mask):
+    """Fused cumulative pow2-bucket update: count masked samples into
+    hist (<= edge per bucket, +Inf last) and accumulate their sum."""
+    nb = hist.shape[0] - 1
+    edges = jnp.asarray(pow2_edges(nb), jnp.int32)
+    axes = tuple(range(samples.ndim))
+    cum = ((samples[..., None] <= edges) & mask[..., None]).sum(axes)
+    cnt = mask.sum()
+    hist = hist + jnp.concatenate(
+        [cum, cnt[None]]).astype(hist.dtype)
+    total_sum = total_sum + jnp.where(mask, samples, 0).sum().astype(
+        total_sum.dtype)
+    return hist, total_sum
+
+
+def telemetry_update(spec: Spec, tele: FleetTelemetry, pre: NodeState,
+                     post: NodeState, restarted=None,
+                     down=None) -> FleetTelemetry:
+    """One round's telemetry pass: pure reductions over the (unpacked)
+    pre/post round states — reads only, so fusing it into a round
+    program cannot perturb the state trajectory.
+
+    ``restarted``/``down`` ([M, C] bool or None) come from the chaos
+    tier's crash bookkeeping: nodes whose restart completed this round
+    (starts the heal clock) and nodes currently down (counts toward the
+    group's heal_rounds lane). None compiles the heal machinery down to
+    the carry passthrough it is without crash faults.
+    """
+    r = tele.round
+    L = spec.L
+    dt = jnp.int32
+
+    # -- propose→commit latency via the birth ring -----------------------
+    li = post.last_index.max(axis=0)                      # [C]
+    cm = post.commit.max(axis=0)                          # [C]
+    slots = jnp.arange(L, dtype=dt)[:, None]              # [L, 1]
+    # log index currently stored at each ring slot given frontier li
+    # (same cursor arithmetic as engine.member_window_mask)
+    ent_idx = li[None, :] - ((li[None, :] - 1 - slots) % L)
+    born = (ent_idx > tele.prev_last[None, :]) & (ent_idx > 0)
+    birth = jnp.where(born, r, tele.birth_ring)
+    # prev_commit is a RUNNING MAX: a commit frontier legally regressing
+    # across a persist-nothing crash must not re-sample its entries
+    committed = (
+        (ent_idx > tele.prev_commit[None, :])
+        & (ent_idx <= cm[None, :]) & (ent_idx > 0)
+    )
+    commit_hist, commit_sum = _hist_add(
+        tele.commit_hist, tele.commit_sum,
+        jnp.maximum(r - birth, 0), committed)
+
+    # -- election duration (candidate→leader rounds) ---------------------
+    is_cand = (post.role == ROLE_PRE_CANDIDATE) | (
+        post.role == ROLE_CANDIDATE)
+    cand_since = jnp.where(is_cand & (tele.cand_since < 0), r,
+                           tele.cand_since)
+    new_lead = (post.role == ROLE_LEADER) & (pre.role != ROLE_LEADER)
+    elect_hist, elect_sum = _hist_add(
+        tele.elect_hist, tele.elect_sum,
+        jnp.where(cand_since >= 0, r - cand_since, 0), new_lead)
+    # leaving candidacy (won, or demoted back to follower) clears the clock
+    cand_since = jnp.where(is_cand, cand_since, -1)
+    leader_changes = tele.leader_changes + new_lead.any(axis=0).astype(dt)
+
+    # -- snapshot installs: ring apply advances `applied` by at most
+    # Spec.A per round, so a bigger jump can only be a MsgSnap install
+    # (the same sound detector as engine.build_kv_round); crash rewinds
+    # move applied DOWN and never count
+    inst = (post.applied - pre.applied) > spec.A
+    snapshot_installs = tele.snapshot_installs + inst.any(axis=0).astype(dt)
+
+    # -- post-crash heal time (restart → caught up to the commit frontier)
+    heal_since = tele.heal_since
+    if restarted is not None:
+        heal_since = jnp.where(restarted, r, heal_since)
+    healed = (heal_since >= 0) & (post.commit >= cm[None, :])
+    if down is not None:
+        healed = healed & ~down
+    heal_hist, heal_sum = _hist_add(
+        tele.heal_hist, tele.heal_sum,
+        jnp.maximum(r - heal_since, 0), healed)
+    heal_since = jnp.where(healed, -1, heal_since)
+    healing = heal_since >= 0
+    if down is not None:
+        healing = healing | down
+    heal_rounds = tele.heal_rounds + healing.any(axis=0).astype(dt)
+
+    return tele.replace(
+        round=r + 1,
+        leader_changes=leader_changes,
+        snapshot_installs=snapshot_installs,
+        heal_rounds=heal_rounds,
+        commit_hist=commit_hist, commit_sum=commit_sum,
+        elect_hist=elect_hist, elect_sum=elect_sum,
+        heal_hist=heal_hist, heal_sum=heal_sum,
+        birth_ring=birth,
+        prev_last=li,
+        prev_commit=jnp.maximum(tele.prev_commit, cm),
+        cand_since=cand_since, heal_since=heal_since,
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side reporting
+# ---------------------------------------------------------------------------
+
+
+def hist_percentile(hist, q: float):
+    """Percentile from a cumulative pow2 histogram: the smallest bucket
+    upper bound covering fraction q of the samples (Prometheus
+    histogram_quantile semantics on our integer buckets). None with no
+    samples; the +Inf bucket answers float('inf')."""
+    h = np.asarray(hist)
+    total = int(h[-1])
+    if total == 0:
+        return None
+    target = q * total
+    for i in range(len(h) - 1):
+        if int(h[i]) >= target:
+            return 1 << i
+    return float("inf")
+
+
+def _json_pctl(p):
+    # a percentile past the top finite edge is the string "inf", never
+    # float('inf'): json.dumps would emit the bare token Infinity,
+    # which strict JSON parsers (jq, JSON.parse) reject — the evidence
+    # files must stay RFC-8259 clean
+    return "inf" if p == float("inf") else p
+
+
+def _hist_block(hist, total_sum) -> dict:
+    h = np.asarray(hist)
+    nb = len(h) - 1
+    return {
+        "hist": {**{f"le_{e}": int(c)
+                    for e, c in zip(pow2_edges(nb), h[:-1])},
+                 "inf": int(h[-1])},
+        "count": int(h[-1]),
+        "sum": int(total_sum),
+        "p50": _json_pctl(hist_percentile(h, 0.5)),
+        "p99": _json_pctl(hist_percentile(h, 0.99)),
+    }
+
+
+def telemetry_report(tele: FleetTelemetry, groups: int | None = None) -> dict:
+    """One host transfer -> plain-dict report. ``groups`` restricts the
+    per-group lanes to the first N (the harness Cluster's canonical-lane
+    padding must not leak idle lanes into lane aggregates)."""
+    t = jax.device_get(tele)
+    sl = slice(None) if groups is None else slice(0, groups)
+    lanes = {
+        "leader_changes": np.asarray(t.leader_changes)[sl],
+        "snapshot_installs": np.asarray(t.snapshot_installs)[sl],
+        "heal_rounds": np.asarray(t.heal_rounds)[sl],
+    }
+    out = {"rounds": int(t.round)}
+    for name, v in lanes.items():
+        out[f"{name}_total"] = int(v.sum())
+        out[f"{name}_max_group"] = int(v.max()) if v.size else 0
+    out["commit_latency_rounds"] = _hist_block(t.commit_hist, t.commit_sum)
+    out["election_duration_rounds"] = _hist_block(t.elect_hist, t.elect_sum)
+    out["heal_latency_rounds"] = _hist_block(t.heal_hist, t.heal_sum)
+    # per-lane sign check: numpy sums int32 lanes in int64, so one
+    # wrapped (negative) lane can hide behind other lanes' totals
+    wrapped = any(bool((v < 0).any()) for v in lanes.values())
+    for hist, s in ((t.commit_hist, t.commit_sum),
+                    (t.elect_hist, t.elect_sum),
+                    (t.heal_hist, t.heal_sum)):
+        wrapped |= int(np.asarray(hist)[-1]) < 0 or int(np.asarray(s)) < 0
+    if wrapped:
+        raise OverflowError(
+            "FleetTelemetry counter wrapped (i32); shorten the window or "
+            "re-init telemetry per report window")
+    return out
+
+
+def flight_record(tele: FleetTelemetry, viol=None, crash_metrics=None,
+                  kind: str = "") -> dict:
+    """One timeline row of the chaos flight recorder: a compact
+    host-side snapshot of the cumulative telemetry + violation +
+    crash counters at an epoch boundary. All counters are cumulative,
+    so consecutive rows are monotone non-decreasing per field — the
+    property the smoke tier asserts."""
+    # narrow transfer: ONLY the histograms/scalars the row needs, with
+    # the [C] lanes reduced on device — never the [L, C] birth ring or
+    # the [M, C] clocks (at C=1M the ring alone is tens of MB; hauling
+    # it to host twice per fault/heal cycle would dwarf the row)
+    t = jax.device_get({
+        "round": tele.round,
+        "commit_hist": tele.commit_hist, "commit_sum": tele.commit_sum,
+        "elect_hist": tele.elect_hist, "elect_sum": tele.elect_sum,
+        "heal_hist": tele.heal_hist, "heal_sum": tele.heal_sum,
+        "leader_changes": tele.leader_changes.sum(),
+        "snapshot_installs": tele.snapshot_installs.sum(),
+        "heal_rounds": tele.heal_rounds.sum(),
+    })
+    rec = {
+        "kind": kind,
+        "round": int(t["round"]),
+        "commit_hist": [int(v) for v in np.asarray(t["commit_hist"])],
+        "commit_sum": int(t["commit_sum"]),
+        "elect_hist": [int(v) for v in np.asarray(t["elect_hist"])],
+        "elect_sum": int(t["elect_sum"]),
+        "heal_hist": [int(v) for v in np.asarray(t["heal_hist"])],
+        "heal_sum": int(t["heal_sum"]),
+        "leader_changes": int(t["leader_changes"]),
+        "snapshot_installs": int(t["snapshot_installs"]),
+        "heal_rounds": int(t["heal_rounds"]),
+    }
+    # an i32 wrap (very long window at very large C) shows up as a
+    # negative counter; flag the row instead of silently breaking the
+    # monotone-timeline property downstream consumers rely on
+    rec["wrapped"] = (
+        any(v < 0 for hk in ("commit_hist", "elect_hist", "heal_hist")
+            for v in rec[hk])
+        or any(rec[k] < 0 for k in ("commit_sum", "elect_sum", "heal_sum",
+                                    "leader_changes", "snapshot_installs",
+                                    "heal_rounds")))
+    if viol is not None:
+        v = jax.device_get(viol)
+        rec["violations"] = {
+            k: int(getattr(v, k)) for k in type(v).__dataclass_fields__
+        }
+    if crash_metrics is not None:
+        m = jax.device_get(crash_metrics)
+        for k in ("crashes_injected", "entries_lost_fsync",
+                  "restarts_completed", "conf_changes_applied"):
+            rec[k] = int(getattr(m, k))
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition format (the /metrics wire form)
+# ---------------------------------------------------------------------------
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        if v == float("inf"):
+            return "+Inf"
+        return repr(v)
+    return str(v)
+
+
+def prometheus_render(families) -> str:
+    """Render metric families to exposition text. ``families`` is a list
+    of (name, mtype, help, samples); each sample is (suffix, labels,
+    value) — suffix "" for plain counters/gauges, "_bucket"/"_sum"/
+    "_count" for histogram series, labels a (possibly empty) dict."""
+    lines = []
+    for name, mtype, help_text, samples in families:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for suffix, labels, value in samples:
+            lab = ""
+            if labels:
+                inner = ",".join(
+                    f'{k}="{v}"' for k, v in labels.items())
+                lab = "{" + inner + "}"
+            lines.append(f"{name}{suffix}{lab} {_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def histogram_samples(edges, cum_counts, count: int, total_sum) -> list:
+    """The _bucket/_sum/_count triplet for one histogram family from
+    cumulative bucket counts (+Inf implied by ``count``)."""
+    out = [("_bucket", {"le": str(e)}, int(c))
+           for e, c in zip(edges, cum_counts)]
+    out.append(("_bucket", {"le": "+Inf"}, int(count)))
+    out.append(("_sum", {}, total_sum))
+    out.append(("_count", {}, int(count)))
+    return out
+
+
+def prometheus_parse(text: str) -> dict:
+    """Parse exposition text back into families, VALIDATING conformance:
+    every sample must belong to a # TYPE-declared family (histogram
+    series match via their _bucket/_sum/_count suffixes), histogram
+    buckets must be cumulative non-decreasing and end in an +Inf bucket
+    equal to _count. Returns {family: {"type", "help", "samples":
+    {(series_name, ((label, value), ...)): float}}} — the round-trip
+    test re-renders and compares."""
+    import re
+
+    fams: dict = {}
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$")
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            fams.setdefault(name, {"samples": {}})["help"] = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, mtype = rest.partition(" ")
+            if mtype not in ("counter", "gauge", "histogram", "summary",
+                             "untyped"):
+                raise ValueError(f"line {ln}: unknown metric type {mtype!r}")
+            fams.setdefault(name, {"samples": {}})["type"] = mtype
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        m = sample_re.match(line)
+        if m is None:
+            raise ValueError(f"line {ln}: unparseable sample {line!r}")
+        sname, _, rawlab, rawval = m.groups()
+        labels = {}
+        if rawlab:
+            for part in re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"',
+                                   rawlab):
+                labels[part[0]] = part[1]
+        value = float(rawval.replace("+Inf", "inf"))
+        fam = sname
+        if fam not in fams:
+            for suf in ("_bucket", "_sum", "_count"):
+                if sname.endswith(suf) and sname[: -len(suf)] in fams:
+                    fam = sname[: -len(suf)]
+                    break
+        if fam not in fams or "type" not in fams[fam]:
+            raise ValueError(
+                f"line {ln}: sample {sname!r} has no # TYPE declaration")
+        key = (sname, tuple(sorted(labels.items())))
+        fams[fam]["samples"][key] = value
+    # histogram conformance: buckets cumulative, +Inf present == _count
+    for name, fam in fams.items():
+        if fam.get("type") != "histogram":
+            continue
+        raw_buckets = [(dict(k[1]).get("le"), v)
+                       for k, v in fam["samples"].items()
+                       if k[0] == name + "_bucket"]
+        if any(le is None for le, _ in raw_buckets):
+            raise ValueError(f"histogram {name} has a _bucket sample "
+                             "without an le label")
+        buckets = sorted(
+            raw_buckets,
+            key=lambda kv: float(kv[0].replace("+Inf", "inf")))
+        if not buckets or buckets[-1][0] != "+Inf":
+            raise ValueError(f"histogram {name} missing +Inf bucket")
+        counts = [v for _, v in buckets]
+        if any(a > b for a, b in zip(counts, counts[1:])):
+            raise ValueError(f"histogram {name} buckets not cumulative")
+        cnt = fam["samples"].get((name + "_count", ()))
+        if cnt is None or cnt != buckets[-1][1]:
+            raise ValueError(f"histogram {name} +Inf bucket != _count")
+        if (name + "_sum", ()) not in fam["samples"]:
+            raise ValueError(f"histogram {name} missing _sum")
+    return fams
+
+
+def server_metric_families(summary: dict, telemetry: dict | None = None,
+                           contention=None) -> list:
+    """The /metrics endpoint's family list: etcd-reference metric names
+    over the fleet summary (models/metrics.py fleet_summary), the
+    telemetry report's latency histograms when the serving cluster
+    carries a telemetry plane, and the legacy etcd_tpu_* gauges the
+    earlier evidence runs scraped."""
+    g = "gauge"
+
+    def plain(v):
+        return [("", {}, v)]
+
+    fams = [
+        ("etcd_server_has_leader", g,
+         "Whether or not a leader exists (1 / 0).",
+         plain(int(summary["groups_with_leader"] == summary["groups"]))),
+        ("etcd_server_proposals_committed_total", g,
+         "The total number of consensus proposals committed.",
+         plain(summary["commit_max"])),
+        ("etcd_server_proposals_applied_total", g,
+         "The total number of consensus proposals applied.",
+         plain(summary.get("applied_max", summary["commit_max"]))),
+        ("etcd_server_proposals_pending", g,
+         "The current number of pending proposals to commit.",
+         plain(summary.get("lag_sum", 0))),
+        ("etcd_server_leader_changes_seen_total", "counter",
+         "The number of leader changes seen.",
+         plain(telemetry["leader_changes_total"] if telemetry else 0)),
+        # legacy gauges (kept verbatim: earlier scrapes + tests match
+        # on these exact sample lines)
+        ("etcd_tpu_groups", g, "Raft groups in the fleet.",
+         plain(summary["groups"])),
+        ("etcd_tpu_groups_with_leader", g, "Groups with >= 1 leader.",
+         plain(summary["groups_with_leader"])),
+        ("etcd_tpu_commit_max", g, "Max commit index across the fleet.",
+         plain(summary["commit_max"])),
+        ("etcd_tpu_commit_apply_lag_max", g,
+         "Max commit-apply lag (entries).",
+         plain(summary["commit_apply_lag_max"])),
+        ("etcd_tpu_term_max", g, "Max term across the fleet.",
+         plain(summary["term_max"])),
+    ]
+    lag_hist = summary.get("commit_apply_lag_hist")
+    if lag_hist is not None:
+        edges = [k[3:] for k in lag_hist if k.startswith("le_")]
+        cum = [lag_hist[f"le_{e}"] for e in edges]
+        fams.append((
+            "etcd_tpu_commit_apply_lag_entries", "histogram",
+            "Commit-apply lag across fleet nodes at scrape time "
+            "(entries).",
+            histogram_samples(edges, cum, lag_hist["inf"],
+                              summary.get("lag_sum", 0)),
+        ))
+    if telemetry is not None:
+        for key, mname, help_text in (
+            ("commit_latency_rounds", "etcd_tpu_commit_latency_rounds",
+             "Propose-to-commit latency (lockstep rounds)."),
+            ("election_duration_rounds",
+             "etcd_tpu_election_duration_rounds",
+             "Candidate-to-leader election duration (lockstep rounds)."),
+            ("heal_latency_rounds", "etcd_tpu_heal_latency_rounds",
+             "Crash-restart to caught-up heal time (lockstep rounds)."),
+        ):
+            blk = telemetry[key]
+            edges = [k[3:] for k in blk["hist"] if k.startswith("le_")]
+            cum = [blk["hist"][f"le_{e}"] for e in edges]
+            fams.append((mname, "histogram", help_text,
+                         histogram_samples(edges, cum, blk["count"],
+                                           blk["sum"])))
+        fams.append((
+            "etcd_tpu_snapshot_installs_total", "counter",
+            "Snapshot installs observed (applied-jump detector).",
+            plain(telemetry["snapshot_installs_total"])))
+    if contention is not None:
+        fams.append((
+            "etcd_tpu_ticker_late_total", "counter",
+            "Ticks later than the contention threshold.",
+            plain(contention.late_total)))
+        fams.append((
+            "etcd_tpu_ticker_late_max_seconds", g,
+            "Worst observed tick lateness.",
+            plain(float(f"{contention.max_exceeded:.6f}"))))
+    return fams

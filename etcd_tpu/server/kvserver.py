@@ -168,8 +168,14 @@ class EtcdCluster:
         durable_proposes: bool = False,
         apply_plane: str = "host",
         kv_keys: int = 64,
+        telemetry: bool = False,
     ):
-        self.cl = cluster or Cluster(n_members=n_members)
+        # telemetry=True attaches the fleet telemetry plane to the
+        # backing Cluster (harness/cluster.py): /metrics then serves the
+        # latency-histogram families (v3rpc) from it. Ignored when an
+        # explicit `cluster` is injected — its owner decides.
+        self.cl = cluster or Cluster(n_members=n_members,
+                                     telemetry=telemetry)
         # acknowledged ⇒ on disk: fsync the members' backends before a
         # propose returns (the reference gets this from WAL MustSync
         # before the Ready is acked, storage.go; here the device ring

@@ -740,30 +740,40 @@ class V3Server:
                         "etcdcluster": cv or MIN_CLUSTER_VERSION,
                     })
                 elif self.path == "/metrics":
+                    # Prometheus exposition format (api/etcdhttp metrics):
+                    # etcd-reference metric names with # HELP/# TYPE
+                    # declarations and histogram _bucket/_sum/_count
+                    # triplets — parseable by any exposition-format
+                    # scraper (round-trip test in tests/test_v3rpc.py)
                     from etcd_tpu.models.metrics import fleet_summary
+                    from etcd_tpu.models.telemetry import (
+                        PROMETHEUS_CONTENT_TYPE,
+                        prometheus_render,
+                        server_metric_families,
+                        telemetry_report,
+                    )
 
                     with api.lock:
                         s = fleet_summary(api.ec.cl.s)
-                    lines = [
-                        f"etcd_tpu_groups {s['groups']}",
-                        f"etcd_tpu_groups_with_leader {s['groups_with_leader']}",
-                        f"etcd_tpu_commit_max {s['commit_max']}",
-                        f"etcd_tpu_commit_apply_lag_max {s['commit_apply_lag_max']}",
-                        f"etcd_tpu_term_max {s['term_max']}",
-                    ]
-                    td = getattr(api.ec, "contention", None)
-                    if td is not None:
-                        # late-tick contention (pkg/contention analog)
-                        lines.append(
-                            f"etcd_tpu_ticker_late_total {td.late_total}"
-                        )
-                        lines.append(
-                            "etcd_tpu_ticker_late_max_seconds "
-                            f"{td.max_exceeded:.6f}"
-                        )
-                    blob = ("\n".join(lines) + "\n").encode()
+                        tele = getattr(api.ec.cl, "tele", None)
+                        trep = None
+                        if tele is not None:
+                            try:
+                                trep = telemetry_report(
+                                    tele, groups=api.ec.cl.C)
+                            except OverflowError:
+                                # a wrapped i32 window on a long-lived
+                                # server must not poison every future
+                                # scrape: open a fresh window and serve
+                                # this scrape without the latency
+                                # families
+                                api.ec.cl.reset_telemetry()
+                        td = getattr(api.ec, "contention", None)
+                    blob = prometheus_render(server_metric_families(
+                        s, trep, contention=td)).encode()
                     self.send_response(200)
-                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Type",
+                                     PROMETHEUS_CONTENT_TYPE)
                     self.send_header("Content-Length", str(len(blob)))
                     self.end_headers()
                     self.wfile.write(blob)

@@ -22,7 +22,11 @@
 # full-program bit-identity (tests/test_packed_state.py, C=16),
 # sparse-outbox steady bit-identity (tests/test_sparse_outbox.py) and
 # fleet-carry donation safety (tests/test_donation.py) — they guard the
-# same round program this tier exists for.
+# same round program this tier exists for. The telemetry tier
+# (tests/test_telemetry.py) runs here too: round-program bit-identity
+# with the telemetry plane fused in (dense + diet forms), a host-replay
+# histogram cross-check, and the small-C chaos flight-recorder run
+# asserting the per-epoch timeline is present and monotone.
 cd "$(dirname "$0")"
 exec python -m pytest -q -m 'not slow' \
   tests/test_datadriven_quorum.py \
@@ -44,4 +48,5 @@ exec python -m pytest -q -m 'not slow' \
   tests/test_recovery_crash.py \
   tests/test_recovery_member.py \
   tests/test_device_mvcc.py \
+  tests/test_telemetry.py \
   "$@"

@@ -1,0 +1,562 @@
+"""Fleet telemetry plane (ISSUE 9): bit-identity, host-replay
+cross-checks, the chaos flight recorder, knob validation and the
+Prometheus exposition round trip.
+
+The load-bearing contract is the first one: telemetry RIDES BESIDE the
+fleet state and never feeds back, so a telemetry-on round must
+reproduce the telemetry-off round BIT-FOR-BIT in state and wire — over
+the rich full-program scenario (elections / partitions / snapshot
+fallback / read-index / ticks, the test_packed_state scenario) and
+under the PR-8 diet forms (packed_state, sparse_outbox). The histogram
+MATH is then cross-checked against an independent numpy replay of the
+recorded state trajectory at small C.
+"""
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import pytest
+
+from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
+from etcd_tpu.models.metrics import build_metered_round, zero_metrics
+from etcd_tpu.models.state import NodeState, unpack_fleet, pack_fleet
+from etcd_tpu.models.telemetry import (
+    FleetTelemetry,
+    flight_record,
+    hist_percentile,
+    init_telemetry,
+    pow2_edges,
+    prometheus_parse,
+    prometheus_render,
+    telemetry_report,
+    telemetry_update,
+)
+from etcd_tpu.types import (
+    ENTRY_NORMAL,
+    MSG_APP,
+    MSG_APP_RESP,
+    MSG_PROP,
+    ROLE_CANDIDATE,
+    ROLE_LEADER,
+    ROLE_PRE_CANDIDATE,
+    Spec,
+)
+from etcd_tpu.utils.config import RaftConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the test_packed_state rich-scenario geometry: elections, a partition
+# window long enough for snapshot fallback, a read-index wave, ticks
+SPEC = Spec(M=3, L=16, E=1, K=2, W=2, R=2, A=2)
+CFG = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=2,
+                 inbox_bound=4)
+C = 16
+ROUNDS = 48
+
+
+def _inputs(r: int):
+    M, E = SPEC.M, SPEC.E
+    hup = np.zeros((M, C), bool)
+    if r == 0:
+        for c in range(C):
+            hup[c % M, c] = True
+    plen = np.zeros((M, C), np.int32)
+    pdata = np.zeros((M, E, C), np.int32)
+    ptype = np.zeros((M, E, C), np.int32)
+    if 2 <= r < ROUNDS - 10:
+        plen[0, :] = 1
+        pdata[0, 0, :] = r * 64 + np.arange(C)
+        ptype[0, 0, :] = ENTRY_NORMAL
+    ri = np.zeros((M, C), np.int32)
+    if r == 24:
+        ri[0, :] = 7
+    keep = np.ones((M, M, C), bool)
+    if 8 <= r < 18:
+        keep[1, :, 4:8] = False
+        keep[:, 1, 4:8] = False
+    tick = np.full((M, C), r % 3 == 0 or r >= ROUNDS - 8, bool)
+    return plen, pdata, ptype, ri, hup, tick, keep
+
+
+def _assert_states_equal(a: NodeState, b: NodeState, label: str, r: int):
+    for name in NodeState.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        ), f"{label}: state.{name} diverged at round {r}"
+
+
+@pytest.fixture(scope="module")
+def plain_run():
+    """Reference trajectory: the bare round program, plus the recorded
+    per-round states the replay cross-check consumes."""
+    round_fn = jax.jit(build_round(CFG, SPEC))
+    init = init_fleet(SPEC, C, seed=0, election_tick=CFG.election_tick)
+    state, inbox = init, empty_inbox(SPEC, C)
+    states, inboxes = [], []
+    for r in range(ROUNDS):
+        state, inbox = round_fn(state, inbox, *_inputs(r))
+        states.append(state)
+        inboxes.append(inbox)
+    # rich enough to prove anything: elections happened, the partition
+    # forced a snapshot fallback (laggard re-synced via MsgSnap)
+    assert int((np.asarray(state.role) == ROLE_LEADER).sum()) == C
+    return init, states, inboxes
+
+
+def _telemetered_run(cfg, init_tele_state=None):
+    step = jax.jit(build_metered_round(cfg, SPEC, with_telemetry=True))
+    state = init_fleet(SPEC, C, seed=0, election_tick=cfg.election_tick)
+    base = state
+    if cfg.packed_state:
+        state = pack_fleet(SPEC, state)
+    inbox = empty_inbox(
+        SPEC, C, compact_bound=cfg.inbox_bound if cfg.compact_wire else 0)
+    metrics = zero_metrics()
+    tele = init_telemetry(SPEC, base)
+    states, inboxes = [], []
+    for r in range(ROUNDS):
+        state, inbox, metrics, tele = step(state, inbox, *_inputs(r),
+                                           metrics, tele)
+        states.append(unpack_fleet(SPEC, state) if cfg.packed_state
+                      else state)
+        inboxes.append(inbox)
+    return states, inboxes, tele
+
+
+def test_telemetered_round_state_bit_identity(plain_run):
+    """The tentpole's proof: fused telemetry reductions leave the state
+    AND wire trajectories bit-identical over the rich scenario."""
+    _, ref_states, ref_inboxes = plain_run
+    states, inboxes, tele = _telemetered_run(CFG)
+    for r, (a, b) in enumerate(zip(ref_states, states)):
+        _assert_states_equal(a, b, "telemetered", r)
+    for r, (a, b) in enumerate(zip(ref_inboxes, inboxes)):
+        assert np.array_equal(np.asarray(a.type), np.asarray(b.type)), \
+            f"wire diverged at round {r}"
+    rep = telemetry_report(tele)
+    assert rep["rounds"] == ROUNDS
+    # the scenario elected one leader per group at round ~0 and the
+    # partition cost nothing fleet-wide lasting: lanes saw >= 1 change
+    assert rep["leader_changes_total"] >= C
+    assert rep["commit_latency_rounds"]["count"] > 0
+
+
+def test_telemetered_packed_state_bit_identity(plain_run):
+    """The metered/telemetered round now composes with the PR-8 diet:
+    packed carry in, bit-identical unpacked trajectory out, and the
+    SAME telemetry as the dense telemetered run."""
+    _, ref_states, _ = plain_run
+    pcfg = dataclasses.replace(CFG, packed_state=True)
+    states, _, tele_p = _telemetered_run(pcfg)
+    for r, (a, b) in enumerate(zip(ref_states, states)):
+        _assert_states_equal(a, b, "packed+telemetered", r)
+    _, _, tele_d = _telemetered_run(CFG)
+    for name in FleetTelemetry.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(tele_p, name)),
+            np.asarray(getattr(tele_d, name))
+        ), f"telemetry.{name} diverged between packed and dense"
+
+
+def test_telemetered_sparse_outbox_bit_identity():
+    """Steady-traffic bit-identity under the diet's sparse_outbox form
+    (the rich scenario is out of scope for the steady message classes —
+    same contract split as tests/test_sparse_outbox.py)."""
+    spec = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+    full = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                      inbox_bound=4, coalesce_commit_refresh=True)
+    sparse = dataclasses.replace(
+        full, local_steps=("prop",),
+        message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP),
+        deferred_emit=True, sparse_outbox=True)
+    Cs = 4
+    M, E = spec.M, spec.E
+    boot = jax.jit(build_round(full, spec))
+    state = init_fleet(spec, Cs, seed=0, election_tick=full.election_tick)
+    inbox = empty_inbox(spec, Cs)
+    z2 = np.zeros((M, Cs), np.int32)
+    zp = np.zeros((M, E, Cs), np.int32)
+    no = np.zeros((M, Cs), bool)
+    keep = np.ones((M, M, Cs), bool)
+    hup = no.copy()
+    hup[0, :] = True
+    state, inbox = boot(state, inbox, z2, zp, zp, z2, hup, no, keep)
+    for _ in range(12):
+        state, inbox = boot(state, inbox, z2, zp, zp, z2, no, no, keep)
+    assert (np.asarray(state.role)[0] == ROLE_LEADER).all()
+    assert int((np.asarray(inbox.type) != 0).sum()) == 0
+
+    plen = z2.copy()
+    plen[0, :] = 1
+    pdata = zp.copy()
+    pdata[0, 0, :] = 9
+    args = (plen, pdata, zp, z2, no, no, keep)
+    bare = jax.jit(build_round(sparse, spec))
+    met = jax.jit(build_metered_round(sparse, spec, with_telemetry=True))
+    s_a, i_a = state, inbox
+    s_b, i_b = state, inbox
+    metrics, tele = zero_metrics(), init_telemetry(spec, state)
+    for r in range(12):
+        s_a, i_a = bare(s_a, i_a, *args)
+        s_b, i_b, metrics, tele = met(s_b, i_b, *args, metrics, tele)
+        _assert_states_equal(s_a, s_b, "sparse_outbox+telemetered", r)
+        assert np.array_equal(np.asarray(i_a.type), np.asarray(i_b.type))
+    rep = telemetry_report(tele)
+    # steady commits: every round samples C entries at the pipeline lat
+    assert rep["commit_latency_rounds"]["count"] >= 8 * Cs
+    assert rep["commit_latency_rounds"]["p99"] <= 4
+
+
+# ---------------------------------------------------------------------------
+# host replay cross-check: an independent numpy reimplementation of the
+# telemetry definitions over the recorded state trajectory
+# ---------------------------------------------------------------------------
+
+
+def _replay(spec, init, states, buckets=8):
+    M, L = spec.M, spec.L
+    Cn = np.asarray(init.term).shape[-1]
+    nb1 = buckets + 1
+    edges = np.asarray(pow2_edges(buckets))
+    hists = {k: np.zeros(nb1, np.int64) for k in ("commit", "elect")}
+    lanes = {"leader_changes": np.zeros(Cn, np.int64),
+             "snapshot_installs": np.zeros(Cn, np.int64)}
+    birth = np.zeros((L, Cn), np.int64)
+    prev_last = np.asarray(init.last_index).max(axis=0).astype(np.int64)
+    prev_commit = np.asarray(init.commit).max(axis=0).astype(np.int64)
+    cand_since = np.full((M, Cn), -1, np.int64)
+
+    def sample(key, lat):
+        hists[key][:-1] += lat <= edges
+        hists[key][-1] += 1
+
+    pre = init
+    for r, post in enumerate(states):
+        role_pre = np.asarray(pre.role)
+        role = np.asarray(post.role)
+        li = np.asarray(post.last_index).max(axis=0)
+        cm = np.asarray(post.commit).max(axis=0)
+        for c in range(Cn):
+            for slot in range(L):
+                idx = li[c] - ((li[c] - 1 - slot) % L)
+                if idx > prev_last[c] and idx > 0:
+                    birth[slot, c] = r
+            for slot in range(L):
+                idx = li[c] - ((li[c] - 1 - slot) % L)
+                if prev_commit[c] < idx <= cm[c] and idx > 0:
+                    sample("commit", max(r - birth[slot, c], 0))
+        is_cand = (role == ROLE_PRE_CANDIDATE) | (role == ROLE_CANDIDATE)
+        cand_since = np.where(is_cand & (cand_since < 0), r, cand_since)
+        new_lead = (role == ROLE_LEADER) & (role_pre != ROLE_LEADER)
+        for m, c in zip(*np.nonzero(new_lead)):
+            sample("elect",
+                   r - cand_since[m, c] if cand_since[m, c] >= 0 else 0)
+        cand_since = np.where(is_cand, cand_since, -1)
+        lanes["leader_changes"] += new_lead.any(axis=0)
+        inst = (np.asarray(post.applied) - np.asarray(pre.applied)) > spec.A
+        lanes["snapshot_installs"] += inst.any(axis=0)
+        prev_last = li
+        prev_commit = np.maximum(prev_commit, cm)
+        pre = post
+    return hists, lanes
+
+
+def test_histograms_match_host_replay(plain_run):
+    """The device histograms/lanes equal an independent numpy replay of
+    the same definitions over the recorded trajectory — including the
+    snapshot-install lane the partition window provokes."""
+    init, ref_states, _ = plain_run
+    _, _, tele = _telemetered_run(CFG)
+    hists, lanes = _replay(SPEC, init, ref_states)
+    assert np.array_equal(np.asarray(tele.commit_hist), hists["commit"])
+    assert np.array_equal(np.asarray(tele.elect_hist), hists["elect"])
+    assert np.array_equal(np.asarray(tele.leader_changes),
+                          lanes["leader_changes"])
+    assert np.array_equal(np.asarray(tele.snapshot_installs),
+                          lanes["snapshot_installs"])
+    # the partition window really forced a snapshot fallback somewhere
+    assert lanes["snapshot_installs"].sum() > 0
+    # heal machinery is compiled out without crash masks: all zero
+    assert int(np.asarray(tele.heal_hist)[-1]) == 0
+    assert int(np.asarray(tele.heal_rounds).sum()) == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_epoch_bit_identity_with_telemetry():
+    """The chaos epoch program with the telemetry carry produces the
+    exact same state/wire/violations/key as the program without it."""
+    from etcd_tpu.harness.chaos import (
+        build_chaos_epoch,
+        empty_crash_state,
+        zero_violations,
+    )
+    import jax.numpy as jnp
+
+    Cs, rounds = 8, 8
+    M = SPEC.M
+    state = init_fleet(SPEC, Cs, seed=2, election_tick=CFG.election_tick)
+    inbox = empty_inbox(SPEC, Cs)
+    crash = empty_crash_state(state)
+    key = jax.random.PRNGKey(7)
+    prop_len = jnp.zeros((M, Cs), jnp.int32).at[0].set(1)
+    prop_data = jnp.zeros((M, SPEC.E, Cs), jnp.int32).at[0, 0].set(7)
+    pal = jnp.zeros((1,), jnp.int32)
+    ops = (jnp.float32(0.05), jnp.float32(0.0), jnp.float32(0.1),
+           jnp.float32(0.08), jnp.int32(2), jnp.bool_(True),
+           jnp.bool_(True), jnp.float32(0.0), pal, jnp.float32(1.0),
+           jnp.float32(1.0))
+    plain = jax.jit(build_chaos_epoch(
+        CFG, SPEC, rounds, with_delay=False, with_crash=True))
+    telem = jax.jit(build_chaos_epoch(
+        CFG, SPEC, rounds, with_delay=False, with_crash=True,
+        with_telemetry=True))
+    tele = init_telemetry(SPEC, state)
+    out_a = plain(state, inbox, None, crash, key, prop_len, prop_data,
+                  zero_violations(), None, *ops)
+    out_b = telem(state, inbox, None, crash, key, prop_len, prop_data,
+                  zero_violations(), tele, *ops)
+    _assert_states_equal(out_a[0], out_b[0], "chaos epoch", rounds)
+    assert np.array_equal(np.asarray(out_a[1].type),
+                          np.asarray(out_b[1].type))
+    for leaf_a, leaf_b in zip(jax.tree.leaves(out_a[5]),
+                              jax.tree.leaves(out_b[5])):
+        assert np.array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
+    assert np.array_equal(np.asarray(out_a[4]), np.asarray(out_b[4]))
+    assert out_b[6] is not None  # telemetry came back
+    assert int(np.asarray(out_b[6].round)) == rounds
+
+
+def test_chaos_flight_recorder_timeline():
+    """run_chaos(telemetry=True) emits one cumulative flight-recorder
+    row per epoch: rounds advance, every counter is monotone
+    non-decreasing, and the crash tier's heal machinery feeds the
+    heal histogram."""
+    from etcd_tpu.harness.chaos import run_chaos
+    from etcd_tpu.utils.config import CrashConfig
+
+    rep = run_chaos(
+        SPEC, CFG, C=8, rounds=50, epoch_len=25, heal_len=25, seed=1,
+        drop_p=0.03, delay_p=0.08, partition_p=0.2,
+        crash_p=0.05, crash=CrashConfig(down_rounds=2), telemetry=True,
+    )
+    tl = rep["timeline"]
+    assert len(tl) >= 2
+    assert [row["kind"] for row in tl[:2]] == ["fault", "heal"]
+    mono_keys = ("round", "commit_sum", "elect_sum", "heal_sum",
+                 "leader_changes", "snapshot_installs", "heal_rounds",
+                 "crashes_injected", "entries_lost_fsync")
+    for a, b in zip(tl, tl[1:]):
+        assert b["round"] > a["round"]
+        for k in mono_keys:
+            assert b[k] >= a[k], (k, a, b)
+        for hk in ("commit_hist", "elect_hist", "heal_hist"):
+            assert all(y >= x for x, y in zip(a[hk], b[hk])), (hk, a, b)
+        assert all(b["violations"][k] >= a["violations"][k]
+                   for k in b["violations"])
+    t = rep["telemetry"]
+    assert t["rounds"] == tl[-1]["round"]
+    assert t["commit_latency_rounds"]["count"] > 0
+    assert t["election_duration_rounds"]["count"] >= 8  # fleet elected
+    if rep["crashes_injected"] > 0:
+        # down rounds count toward some group's heal lane
+        assert t["heal_rounds_total"] > 0
+    # flight_record rows and the final report agree on the totals
+    assert t["leader_changes_total"] == tl[-1]["leader_changes"]
+    assert t["commit_latency_rounds"]["count"] == tl[-1]["commit_hist"][-1]
+
+
+# ---------------------------------------------------------------------------
+# TELEM_* knob validation (the exit-2-before-device-work contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("script,env_extra,needle", [
+    ("bench.py", {"TELEM": "2"}, "TELEM"),
+    ("bench.py", {"TELEM_BUCKETS": "1"}, "TELEM_BUCKETS"),
+    ("chaos_run.py", {"TELEM": "maybe"}, "TELEM"),
+    ("chaos_run.py", {"TELEM_BUCKETS": "99"}, "TELEM_BUCKETS"),
+])
+def test_telem_knob_validation_exits_2(script, env_extra, needle):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **env_extra}
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, script)],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 2, (out.returncode, out.stdout, out.stderr)
+    assert needle in out.stderr
+    assert not out.stdout.strip()
+
+
+# ---------------------------------------------------------------------------
+# reporting primitives + Prometheus exposition round trip
+# ---------------------------------------------------------------------------
+
+
+def test_hist_percentile():
+    # 10 samples: 6 at <=2, 9 at <=4, all at <=8 (cumulative form)
+    h = np.array([0, 6, 9, 10, 10], np.int64)
+    assert hist_percentile(h, 0.5) == 2
+    assert hist_percentile(h, 0.9) == 4
+    assert hist_percentile(h, 0.99) == 8
+    assert hist_percentile(np.zeros(5, np.int64), 0.5) is None
+    # samples past the largest edge land in +Inf
+    h2 = np.array([0, 0, 0, 0, 10], np.int64)
+    assert hist_percentile(h2, 0.5) == float("inf")
+
+
+def test_prometheus_render_parse_roundtrip():
+    from etcd_tpu.models.telemetry import histogram_samples
+
+    fams = [
+        ("etcd_server_has_leader", "gauge", "Whether a leader exists.",
+         [("", {}, 1)]),
+        ("etcd_server_leader_changes_seen_total", "counter",
+         "Leader changes seen.", [("", {}, 3)]),
+        ("etcd_tpu_commit_latency_rounds", "histogram",
+         "Commit latency.",
+         histogram_samples((1, 2, 4), (5, 11, 12), 13, 37)),
+    ]
+    text = prometheus_render(fams)
+    parsed = prometheus_parse(text)
+    assert parsed["etcd_server_has_leader"]["type"] == "gauge"
+    s = parsed["etcd_tpu_commit_latency_rounds"]["samples"]
+    assert s[("etcd_tpu_commit_latency_rounds_bucket",
+              (("le", "2"),))] == 11
+    assert s[("etcd_tpu_commit_latency_rounds_bucket",
+              (("le", "+Inf"),))] == 13
+    assert s[("etcd_tpu_commit_latency_rounds_count", ())] == 13
+    assert s[("etcd_tpu_commit_latency_rounds_sum", ())] == 37
+    # a second render/parse cycle is stable
+    assert prometheus_parse(text) == parsed
+
+
+def test_prometheus_parse_rejects_nonconformant():
+    with pytest.raises(ValueError, match="TYPE"):
+        prometheus_parse("etcd_orphan_metric 1\n")
+    bad_hist = (
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 4\n'
+        "h_sum 9\nh_count 4\n"
+    )
+    with pytest.raises(ValueError, match="cumulative"):
+        prometheus_parse(bad_hist)
+    no_inf = (
+        "# HELP h x\n# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_sum 9\nh_count 5\n'
+    )
+    with pytest.raises(ValueError, match="Inf"):
+        prometheus_parse(no_inf)
+
+
+def test_report_percentiles_stay_json_strict():
+    """A percentile past the top finite edge serializes as the string
+    "inf", never float('inf') — json.dumps would emit the bare token
+    Infinity, which strict parsers reject."""
+    state = init_fleet(SPEC, 2, seed=0)
+    tele = init_telemetry(SPEC, state, buckets=2)
+    # force samples past the top edge (2): fake a large latency by
+    # driving the hist directly through the report path
+    import jax.numpy as jnp
+
+    tele = tele.replace(commit_hist=jnp.asarray([0, 0, 10], jnp.int32),
+                        commit_sum=jnp.int32(1000))
+    rep = telemetry_report(tele)
+    assert rep["commit_latency_rounds"]["p99"] == "inf"
+    json.loads(json.dumps(rep))  # strict round trip
+
+
+def test_cluster_telemetry_rejects_packed_state():
+    from etcd_tpu.harness.cluster import Cluster
+
+    with pytest.raises(ValueError, match="packed_state"):
+        Cluster(n_members=3, spec=SPEC,
+                cfg=dataclasses.replace(CFG, packed_state=True),
+                telemetry=True)
+
+
+def test_cluster_reset_telemetry_opens_fresh_window():
+    from etcd_tpu.harness.cluster import Cluster
+
+    cl = Cluster(n_members=3, spec=SPEC, cfg=CFG, telemetry=True)
+    cl.campaign(0)
+    cl.stabilize()
+    assert int(np.asarray(cl.tele.round)) > 0
+    cl.reset_telemetry()
+    assert int(np.asarray(cl.tele.round)) == 0
+    rep = telemetry_report(cl.tele, groups=cl.C)
+    assert rep["commit_latency_rounds"]["count"] == 0
+
+
+def test_init_telemetry_leaves_share_no_buffers():
+    """Every FleetTelemetry leaf owns its buffer: the chaos epoch
+    programs donate the whole carry on accelerators, and XLA rejects
+    one buffer appearing at two donated positions in a single Execute
+    (the empty_crash_state alias hazard class)."""
+    state = init_fleet(SPEC, 4, seed=0)
+    tele = init_telemetry(SPEC, state)
+    ptrs = [leaf.unsafe_buffer_pointer() for leaf in jax.tree.leaves(tele)]
+    assert len(ptrs) == len(set(ptrs)), "aliased telemetry leaves"
+    state_ptrs = {leaf.unsafe_buffer_pointer()
+                  for leaf in jax.tree.leaves(state)}
+    assert not state_ptrs & set(ptrs), "telemetry leaf aliases state"
+
+
+def test_init_telemetry_rejects_bad_buckets():
+    state = init_fleet(SPEC, 2, seed=0)
+    with pytest.raises(ValueError, match="buckets"):
+        init_telemetry(SPEC, state, buckets=1)
+    with pytest.raises(ValueError, match="buckets"):
+        init_telemetry(SPEC, state, buckets=17)
+
+
+def test_flight_record_shape():
+    state = init_fleet(SPEC, 2, seed=0)
+    tele = init_telemetry(SPEC, state)
+    tele = telemetry_update(SPEC, tele, state, state)
+    rec = flight_record(tele, kind="heal")
+    assert rec["kind"] == "heal" and rec["round"] == 1
+    assert len(rec["commit_hist"]) == 9  # 8 pow2 buckets + inf
+    assert rec["wrapped"] is False
+    assert json.dumps(rec)  # JSON-serializable as-is
+
+
+def test_flight_record_flags_i32_wrap():
+    """A wrapped (negative) i32 counter flags the row instead of
+    silently breaking the timeline's monotone property."""
+    import jax.numpy as jnp
+
+    state = init_fleet(SPEC, 2, seed=0)
+    tele = init_telemetry(SPEC, state)
+    tele = tele.replace(commit_sum=jnp.int32(-5))
+    rec = flight_record(tele)
+    assert rec["wrapped"] is True
+
+
+def test_run_chaos_survives_wrapped_telemetry_window(monkeypatch):
+    """An i32 wrap at the end of a long soak must degrade the summary
+    ({wrapped: true}) rather than discard the whole run's report."""
+    from etcd_tpu.harness import chaos as chaos_mod
+    from etcd_tpu.utils.config import CrashConfig
+
+    def raiser(tele, groups=None):
+        raise OverflowError("forced wrap")
+
+    monkeypatch.setattr(chaos_mod, "telemetry_report", raiser)
+    # same shape/fault mix as test_chaos_flight_recorder_timeline so the
+    # lru-cached epoch programs are reused instead of re-traced
+    rep = chaos_mod.run_chaos(
+        SPEC, CFG, C=8, rounds=50, epoch_len=25, heal_len=25, seed=1,
+        drop_p=0.03, delay_p=0.08, partition_p=0.2,
+        crash_p=0.05, crash=CrashConfig(down_rounds=2), telemetry=True,
+    )
+    assert rep["telemetry"]["wrapped"] is True
+    assert rep["telemetry"]["rounds"] == rep["timeline"][-1]["round"]
+    assert len(rep["timeline"]) >= 2  # the timeline still made it out
