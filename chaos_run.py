@@ -39,12 +39,22 @@ and folds a per-epoch ``timeline`` array (cumulative latency histograms
 ``telemetry`` summary (p50/p99 propose→commit, election and heal
 latencies) into the JSON line — a failing soak is diagnosable post-hoc
 epoch by epoch. TELEM=0 disables (bit-identical state trajectory);
-TELEM_BUCKETS sets the power-of-two histogram bucket count (2..16).
+TELEM_BUCKETS sets the power-of-two histogram bucket count (2..16);
+TELEM_EVERY=N decimates the timeline to every Nth epoch boundary (plus
+the final row) so multi-hour soaks don't grow it without bound.
+
+Black-box forensics (ISSUE 15): CHAOS_BLACKBOX=1 rides the EventRing
+plane (etcd_tpu/models/blackbox.py) — a per-group [W, M] ring of packed
+per-round event words frozen at each group's first violation — and
+folds a ``forensics`` section (decoded per-round per-member timelines
+for the first CHAOS_BLACKBOX_K violating groups; only those groups'
+rings cross PCIe) into the JSON line. CHAOS_BLACKBOX_WINDOW sets the
+ring depth W (2..256, default 32). Bit-identical state trajectory.
 
 All knobs are validated up front: a probability outside [0, 1], a boost
 below 1, an unknown mix/durability name, a TELEM value that is not 0/1,
-or an out-of-range APPLY_*/TELEM_BUCKETS value exits 2 before any
-device work.
+or an out-of-range APPLY_*/TELEM_* value exits 2 before any device
+work.
 """
 from __future__ import annotations
 
@@ -58,7 +68,13 @@ import jax
 
 import functools
 
-from etcd_tpu.utils.knobs import env_bool, env_float, env_int, knob_error
+from etcd_tpu.utils.knobs import (
+    env_bool,
+    env_float,
+    env_int,
+    env_str,
+    knob_error,
+)
 
 # the shared exit-2-before-device-work validation pattern
 # (etcd_tpu/utils/knobs.py), bound to this driver's name
@@ -66,6 +82,7 @@ _knob_error = functools.partial(knob_error, "chaos_run")
 _env_float = functools.partial(env_float, "chaos_run")
 _env_int = functools.partial(env_int, "chaos_run")
 _env_bool = functools.partial(env_bool, "chaos_run")
+_env_str = functools.partial(env_str, "chaos_run")
 
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
@@ -74,7 +91,7 @@ if os.environ.get("JAX_PLATFORMS"):
 # measured and rejected as the default: the flag is global, so it also
 # changes the fleet's election-timeout randomization, and a 262k run left
 # 32 groups split-voting past the heal budget (threefry recovers fully).
-if os.environ.get("CHAOS_PRNG", "threefry") == "rbg":
+if _env_str("CHAOS_PRNG", "threefry", ("threefry", "rbg")) == "rbg":
     jax.config.update("jax_default_prng_impl", "rbg")
 
 from etcd_tpu.utils.cache import configure_compile_cache
@@ -108,24 +125,16 @@ def main() -> int:
     # partitioned minorities smaller) — see README chaos tiers
     liveness_frac = _env_float(
         "CHAOS_LIVENESS_FRAC", "0.1" if member_p > 0 else "0.2", 0.0, 1.0)
-    raw_iv = os.environ.get("CHAOS_INIT_VOTERS",
-                            "3" if member_p > 0 else "0")
-    try:
-        init_voters = int(raw_iv)
-    except ValueError:
-        _knob_error(f"CHAOS_INIT_VOTERS={raw_iv!r} is not an integer")
-    try:
-        down_rounds = int(os.environ.get("CHAOS_DOWN", "3"))
-    except ValueError:
-        _knob_error(f"CHAOS_DOWN={os.environ['CHAOS_DOWN']!r} is not an "
-                    "integer")
+    init_voters = _env_int("CHAOS_INIT_VOTERS",
+                           "3" if member_p > 0 else "0")
+    down_rounds = _env_int("CHAOS_DOWN", "3")
     try:
         crash_knobs = CrashConfig(
             down_rounds=down_rounds,
-            durability=os.environ.get("CHAOS_DURABILITY", "stable"),
+            durability=_env_str("CHAOS_DURABILITY", "stable"),
         )
         member_cfg = MemberChaosConfig(
-            mix=os.environ.get("CHAOS_MEMBER_MIX", "standard"),
+            mix=_env_str("CHAOS_MEMBER_MIX", "standard"),
             initial_voters=init_voters,
             snap_crash_boost=snap_boost,
             member_crash_boost=member_boost,
@@ -144,18 +153,26 @@ def main() -> int:
     # default — the timeline costs one tiny host transfer per epoch
     telem = _env_bool("TELEM", "1")
     telem_buckets = _env_int("TELEM_BUCKETS", "8", 2, 16)
+    telem_every = _env_int("TELEM_EVERY", "1", 1, None)
+    # black-box forensics plane (models/blackbox.py): off by default —
+    # the ring adds a [W, M, C] i32 resident buffer
+    blackbox = _env_bool("CHAOS_BLACKBOX", "0")
+    blackbox_k = _env_int("CHAOS_BLACKBOX_K", "4", 1, None)
+    blackbox_window = _env_int("CHAOS_BLACKBOX_WINDOW", "32", 2, 256)
+    seed = _env_int("CHAOS_SEED", "0")
+    config_aware = _env_bool("CHAOS_CONFIG_AWARE", "1")
+    sync_dispatch = _env_bool("CHAOS_SYNC", "0")
+    lease_tier = _env_bool("CHAOS_LEASE", "1")
 
-    env_w16 = os.environ.get("CHAOS_WIRE16")
-    if member_p > 0 and env_w16 is not None and env_w16 != "0":
-        # same truthiness rule as the parse below — any non-"0" value
-        # asks for the int16 wire, which cc words cannot ride
+    wire16_knob = _env_bool("CHAOS_WIRE16", "1")
+    if member_p > 0 and "CHAOS_WIRE16" in os.environ and wire16_knob:
         _knob_error("CHAOS_MEMBER needs the int32 wire (conf-change words "
                     "use bits 16-20); unset CHAOS_WIRE16")
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
-    C = int(os.environ.get("CHAOS_C", 262_144 if on_accel else 1_000))
-    rounds = int(os.environ.get("CHAOS_ROUNDS", 200))
+    C = _env_int("CHAOS_C", str(262_144 if on_accel else 1_000), 1, None)
+    rounds = _env_int("CHAOS_ROUNDS", "200", 1, None)
 
     # bench geometry (bench.py Spec + RaftConfig) so the chaos tier proves
     # the MEASURED headline configuration safe under faults: K=2 slots,
@@ -163,27 +180,27 @@ def main() -> int:
     # the int16 wire are legal under chaos for the same reason they are in
     # steady state — anything the bound evicts is a droppable message (the
     # transport contract already drops via keep-masks), and it is counted.
-    L = int(os.environ.get("CHAOS_L", "16"))
+    L = _env_int("CHAOS_L", "16", 1, None)
     spec = Spec(M=5, L=L, E=1, K=2, W=4, R=2, A=2)
     if init_voters > spec.M:
         # silently collapsing to the all-voters boot would defeat the
         # partial-voter-set intent (no free slots for add words)
         _knob_error(f"CHAOS_INIT_VOTERS={init_voters} exceeds the member "
                     f"count M={spec.M}")
-    bound = int(os.environ.get("CHAOS_BOUND", str(spec.M - 1)))
+    bound = _env_int("CHAOS_BOUND", str(spec.M - 1), 0, None)
     # the membership tier needs the int32 wire (validated above): its
     # conf-change words ride MsgProp/MsgApp ent_data and use bits 16-20
-    wire16 = (os.environ.get("CHAOS_WIRE16", "1") != "0"
-              and member_p == 0)
+    wire16 = wire16_knob and member_p == 0
     # fleet chunking caps the round program's HLO temporaries, exactly as
     # in bench.py — above ~262k resident groups the un-chunked chaos
     # round overflows HBM by mere tens of MB. Chunks of 131,072 (the
     # bench-proven shape) run clean; 262,144-wide chunks at C=524k
     # reproducibly crashed the TPU worker.
-    chunks = int(os.environ.get(
+    chunks = _env_int(
         "CHAOS_CHUNKS",
         str(max(1, C // 131072)) if on_accel and C > 262144 else "1",
-    ))
+        1, None,
+    )
     cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
                      inbox_bound=bound, coalesce_commit_refresh=True,
                      wire_int16=wire16, fleet_chunks=chunks)
@@ -198,13 +215,16 @@ def main() -> int:
     t0 = time.perf_counter()
     rep = run_chaos(
         spec, cfg, C=C, rounds=rounds, epoch_len=epoch_len, heal_len=heal_len,
-        seed=int(os.environ.get("CHAOS_SEED", "0")),
+        seed=seed,
         drop_p=drop_p, delay_p=delay_p, partition_p=partition_p,
         crash_p=crash_p, crash=crash_cfg,
         member_p=member_p, member=member_cfg,
-        config_aware=os.environ.get("CHAOS_CONFIG_AWARE", "1") != "0",
-        sync_dispatch=os.environ.get("CHAOS_SYNC", "0") != "0",
+        config_aware=config_aware,
+        sync_dispatch=sync_dispatch,
         telemetry=telem, telemetry_buckets=telem_buckets,
+        telemetry_every=telem_every,
+        blackbox=blackbox, blackbox_window=blackbox_window,
+        blackbox_k=blackbox_k,
     )
     rep["elapsed_s"] = round(time.perf_counter() - t0, 1)
     rep["platform"] = platform
@@ -218,7 +238,7 @@ def main() -> int:
     # host-layer lease chaos (tester/stresser_lease.go +
     # checker_lease_expire.go analogs): stress/expire leases through
     # keep-mask faults on a small hosted cluster. CHAOS_LEASE=0 skips.
-    if os.environ.get("CHAOS_LEASE", "1") != "0":
+    if lease_tier:
         # host-layer tiers in a CPU subprocess: an EtcdCluster step is a
         # C=1 device dispatch, ~3.5s/op over the TPU tunnel but
         # milliseconds on host CPU, and the tiers prove host-layer
@@ -232,7 +252,7 @@ def main() -> int:
         try:
             out = subprocess.run(
                 [sys.executable, "-m", "etcd_tpu.harness.chaos_lease",
-                 "--seed", os.environ.get("CHAOS_SEED", "0")],
+                 "--seed", str(seed)],
                 capture_output=True, text=True, env=env, timeout=1800,
             )
             lines = [ln for ln in out.stdout.splitlines()
@@ -272,7 +292,7 @@ def main() -> int:
                 KVSpec(keys=apply_knobs["APPLY_KEYS"]),
                 groups=apply_knobs["APPLY_GROUPS"],
                 ops=apply_knobs["APPLY_OPS"],
-                seed=int(os.environ.get("CHAOS_SEED", "0")),
+                seed=seed,
             )
             rep["apply_parity_ok"] = rep["kv_plane"]["parity_ok"]
         except Exception as e:  # noqa: BLE001

@@ -75,6 +75,11 @@ class Config:
     # families (commit latency, election duration) beside the gauges.
     # One extra small fused dispatch per raft step.
     telemetry: bool = False
+    # black-box event ring (models/blackbox.py): per-round packed event
+    # words per member, exportable with the host request spans as a
+    # Chrome/Perfetto trace (blackbox.to_chrome_trace). Same
+    # one-extra-dispatch cost profile as telemetry.
+    blackbox: bool = False
 
     def validate(self) -> None:
         if self.cluster_size < 1:
@@ -219,12 +224,14 @@ class Etcd:
                 return EtcdCluster.boot_from_disk(
                     cfg.data_dir, n_members=1, members=[src],
                     cluster=Cluster(n_members=1, cfg=raft_cfg,
-                        telemetry=cfg.telemetry), **kw,
+                        telemetry=cfg.telemetry,
+                        blackbox=cfg.blackbox), **kw,
                 )
             return EtcdCluster.boot_from_disk(
                 cfg.data_dir, n_members=n, missing_ok=True, uniform=False,
                 cluster=Cluster(n_members=n, cfg=raft_cfg,
-                        telemetry=cfg.telemetry), **kw,
+                        telemetry=cfg.telemetry,
+                        blackbox=cfg.blackbox), **kw,
             )
         if cfg.initial_cluster_state == "existing":
             # bootstrapExistingClusterNoWAL (bootstrap.go:182) fails the
@@ -236,7 +243,8 @@ class Etcd:
         return EtcdCluster(
             n_members=n,
             cluster=Cluster(n_members=n, cfg=raft_cfg,
-                        telemetry=cfg.telemetry),
+                        telemetry=cfg.telemetry,
+                        blackbox=cfg.blackbox),
             data_dir=cfg.data_dir,
             **kw,
         )

@@ -85,6 +85,14 @@ from etcd_tpu.models.engine import (
     snapshot_window_mask,
     wipe_crashed_traffic,
 )
+from etcd_tpu.models.blackbox import (
+    DEFAULT_WINDOW,
+    EventRing,
+    VIOLATION_BIT_NAMES,
+    blackbox_update,
+    forensics_report,
+    init_blackbox,
+)
 from etcd_tpu.models.metrics import (
     CrashMetrics,
     crash_metrics_report,
@@ -134,14 +142,43 @@ def zero_violations() -> Violations:
                       lost_commit=z, log_divergence=z, term_regress=z)
 
 
+class BlackBox(struct.PyTreeNode):
+    """Scan-carried forensics plane (harness side of models/blackbox.py):
+    the per-group event ring plus the per-group violation bookkeeping
+    the on-violation extraction reduces over. ``viol_groups`` is a [C]
+    i32 bitmask over VIOLATION_BIT_NAMES (bit order ==
+    VIOLATION_KEYS); ``viol_round`` is the round a group FIRST violated
+    (-1 = never) — the ring freezes there, aviation-style, so the
+    preserved window is the W rounds leading INTO the violation."""
+
+    ring: EventRing
+    viol_groups: jnp.ndarray  # [C] i32 violation-kind bitmask
+    viol_round: jnp.ndarray   # [C] i32 first-violation round (-1 none)
+
+
+def empty_blackbox(spec: Spec, state: NodeState,
+                   window: int = DEFAULT_WINDOW) -> BlackBox:
+    C = state.term.shape[-1]
+    return BlackBox(
+        ring=init_blackbox(spec, state, window=window),
+        viol_groups=jnp.zeros((C,), jnp.int32),
+        viol_round=jnp.full((C,), -1, jnp.int32),
+    )
+
+
 def check_invariants(state: NodeState, prev_commit: jnp.ndarray,
-                     viol: Violations, exempt=None) -> Violations:
+                     viol: Violations, exempt=None, with_masks: bool = False):
     """One round's checker pass: pure reductions over [M, C] leaves.
 
     ``exempt`` ([M, C] bool or None) excludes nodes from the
     commit-monotonicity check — the crash tier passes this round's crash
     mask, because capping the persisted commit at the durable log is a
-    legal regression (MustSync never covers commit-only advances)."""
+    legal regression (MustSync never covers commit-only advances).
+
+    ``with_masks`` additionally returns the PER-GROUP [C] bool masks
+    (multi_leader, hash_mismatch, commit_regress) the forensics plane
+    accumulates — derived from the very same intermediates the counters
+    sum, so the counters stay bit-identical with masks on or off."""
     M = state.role.shape[0]
     is_lead = state.role == ROLE_LEADER            # [M, C]
     term = state.term
@@ -150,15 +187,19 @@ def check_invariants(state: NodeState, prev_commit: jnp.ndarray,
     both_lead = is_lead[iu] & is_lead[ju] & (term[iu] == term[ju])
     same_applied = state.applied[iu] == state.applied[ju]
     diff_hash = state.applied_hash[iu] != state.applied_hash[ju]
+    hash_mm = same_applied & diff_hash
     regress = state.commit < prev_commit
     if exempt is not None:
         regress = regress & ~exempt
-    return viol.replace(
+    viol = viol.replace(
         multi_leader=viol.multi_leader + both_lead.sum().astype(jnp.int32),
-        hash_mismatch=viol.hash_mismatch
-        + (same_applied & diff_hash).sum().astype(jnp.int32),
+        hash_mismatch=viol.hash_mismatch + hash_mm.sum().astype(jnp.int32),
         commit_regress=viol.commit_regress + regress.sum().astype(jnp.int32),
     )
+    if not with_masks:
+        return viol
+    return viol, (both_lead.any(axis=0), hash_mm.any(axis=0),
+                  regress.any(axis=0))
 
 
 def refresh_ref_config(state: NodeState, crash: "CrashState") -> "CrashState":
@@ -198,8 +239,8 @@ def refresh_ref_config(state: NodeState, crash: "CrashState") -> "CrashState":
 
 def check_recovery_invariants(
     spec: Spec, state: NodeState, crash: "CrashState", viol: Violations,
-    config_aware,
-) -> tuple[Violations, "CrashState"]:
+    config_aware, with_masks: bool = False,
+):
     """Config-aware crash-recovery checkers (ISSUE 3 + ISSUE 5), as
     per-round tensor reductions; returns (viol, crash) with the
     watermark / term-baseline / reference-config carries refreshed.
@@ -221,13 +262,19 @@ def check_recovery_invariants(
     with every member slot tracked — which MUST fire on a remove-voter
     schedule the config-aware checker accepts (the proof the rework is
     live, mirroring the persist-nothing durability mode).
+
+    ``with_masks`` additionally returns the per-group [C] bool masks
+    (lost_commit, log_divergence, term_regress) for the forensics
+    plane, derived from the same intermediates the counters sum, so the
+    counters stay bit-identical with masks on or off.
     """
     M = spec.M
     crash = refresh_ref_config(state, crash)
     # term monotonicity on the persisted HardState: term/vote fsync
     # before any message reflecting them leaves the node, so nothing —
     # crash included — may move a node's term backwards
-    t_reg = (state.term < crash.prev_term).sum().astype(jnp.int32)
+    t_reg_mask = state.term < crash.prev_term                    # [M, C]
+    t_reg = t_reg_mask.sum().astype(jnp.int32)
 
     # leader completeness: every index the group has ever committed must
     # stay election-safe under the reference config (last_index covers
@@ -276,7 +323,11 @@ def check_recovery_invariants(
         log_divergence=viol.log_divergence
         + diverged.sum().astype(jnp.int32),
     )
-    return viol, crash.replace(watermark=wm, prev_term=state.term)
+    crash = crash.replace(watermark=wm, prev_term=state.term)
+    if not with_masks:
+        return viol, crash
+    return viol, crash, (lost_mask, diverged.any(axis=0),
+                         t_reg_mask.any(axis=0))
 
 
 def member_palette(spec: Spec, mix: str = "standard") -> jnp.ndarray:
@@ -523,14 +574,16 @@ def build_chaos_epoch(
     with_crash: bool = False,
     with_member: bool = False,
     with_telemetry: bool = False,
+    with_blackbox: bool = False,
 ):
     """One jitted chaos epoch: `rounds` lockstep rounds of faulted traffic
     with per-round invariant checks.
 
     Returns fn(state, inbox, held, crash, key, prop_len, prop_data, viol,
-    tele, drop_p, delay_p, partition_p, crash_p, down_rounds, keep_log,
-    config_aware, member_p, palette, snap_boost, member_boost) ->
-    (state, inbox, held, crash, key, viol, tele, commits_delta). The fault
+    tele, bb, drop_p, delay_p, partition_p, crash_p, down_rounds,
+    keep_log, config_aware, member_p, palette, snap_boost, member_boost)
+    -> (state, inbox, held, crash, key, viol, tele, bb, commits_delta).
+    The fault
     probabilities are RUNTIME operands, not closure constants — one
     traced program serves every fault mix (a full trace costs ~40s of
     single-core time; the suite's chaos configurations used to pay it
@@ -581,6 +634,16 @@ def build_chaos_epoch(
     the crash machinery feed the heal-latency histogram; without
     crashes those reduce to carry passthrough at trace time.
 
+    `with_blackbox` rides a BlackBox carry (per-group EventRing +
+    violation bookkeeping, models/blackbox.py) the same way: event
+    words are computed from the same post-wipe pre/post views and the
+    same wire tensors the round produced, the per-round checker passes
+    additionally surface their PER-GROUP masks (derived from the exact
+    intermediates the counters sum, so the counters stay bit-identical),
+    and a group's ring FREEZES at its first violation — the preserved
+    window is the W rounds leading into the failure, which is what a
+    post-mortem needs. Off, callers pass bb=None and get None back.
+
     `with_member` adds the membership-change fault class to fault epochs:
     node 0's per-round proposal becomes an encoded conf-change word with
     probability ``member_p``, sampled from the i32[P] ``palette`` operand
@@ -605,7 +668,7 @@ def build_chaos_epoch(
     with_recovery = with_crash or with_member
 
     def epoch(state, inbox, held, crash, key, prop_len, prop_data, viol,
-              tele, drop_p, delay_p, partition_p, crash_p, down_rounds,
+              tele, bb, drop_p, delay_p, partition_p, crash_p, down_rounds,
               keep_log, config_aware, member_p, palette, snap_boost,
               member_boost):
         prev_commit = state.commit
@@ -741,8 +804,20 @@ def build_chaos_epoch(
         def post_checks(pre, state, prev_commit, crash, viol, hit):
             """Per-round checkers + applied-config transition counting.
             ``pre`` is the state AFTER pre_round (so crash rewinds never
-            count as transitions) and BEFORE the round step."""
-            viol = check_invariants(state, prev_commit, viol, exempt=hit)
+            count as transitions) and BEFORE the round step. With the
+            forensics plane on, also returns the per-group violation
+            bitmask gmask [C] i32 (bit order == VIOLATION_KEYS)."""
+            gmask = None
+            if with_blackbox:
+                viol, masks = check_invariants(state, prev_commit, viol,
+                                               exempt=hit, with_masks=True)
+                C = state.term.shape[-1]
+                gmask = jnp.zeros((C,), jnp.int32)
+                for bit, m in enumerate(masks):
+                    gmask = gmask | jnp.where(m, 1 << bit, 0)
+            else:
+                viol = check_invariants(state, prev_commit, viol,
+                                        exempt=hit)
             if with_recovery:
                 ch = (
                     (pre.voters != state.voters)
@@ -761,9 +836,16 @@ def build_chaos_epoch(
                     joint_left=m.joint_left
                     + (was_j & ~now_j).sum().astype(jnp.int32),
                 ))
-                viol, crash = check_recovery_invariants(
-                    spec, state, crash, viol, config_aware)
-            return crash, viol
+                if with_blackbox:
+                    viol, crash, rmasks = check_recovery_invariants(
+                        spec, state, crash, viol, config_aware,
+                        with_masks=True)
+                    for bit, rm in enumerate(rmasks, start=3):
+                        gmask = gmask | jnp.where(rm, 1 << bit, 0)
+                else:
+                    viol, crash = check_recovery_invariants(
+                        spec, state, crash, viol, config_aware)
+            return crash, viol, gmask
 
         def tele_step(tele, pre, state, alive, restarted):
             """Telemetry pass (read-only; compiled out when off). ``pre``
@@ -775,6 +857,28 @@ def build_chaos_epoch(
                 spec, tele, pre, state,
                 restarted=restarted,
                 down=None if alive is None else ~alive)
+
+        def bb_step(bb, pre, state, consumed, out, hit, alive, rst, gmask):
+            """Forensics pass (read-only; compiled out when off):
+            records this round's event words — freezing groups that have
+            already violated — then folds the round's per-group checker
+            masks into the first-violation bookkeeping. Ordering means a
+            group's OWN violation round is still recorded (the write
+            gate uses the pre-round viol_round), and its ring holds the
+            W rounds ending at that violation."""
+            if not with_blackbox:
+                return bb
+            r = bb.ring.round
+            ring = blackbox_update(
+                spec, bb.ring, pre, state, inbox=consumed, outbox=out,
+                crashed=hit, restarted=rst,
+                down=None if alive is None else ~alive,
+                write_mask=bb.viol_round < 0)
+            fresh = (bb.viol_round < 0) & (gmask != 0)
+            return BlackBox(
+                ring=ring,
+                viol_groups=bb.viol_groups | gmask,
+                viol_round=jnp.where(fresh, r, bb.viol_round))
 
         if faultless:
             # heal program: no fault sampling, no delay bookkeeping. Drain
@@ -790,7 +894,7 @@ def build_chaos_epoch(
             keep_all = jnp.ones((M, M, C), jnp.bool_)
 
             def heal_body(carry, r):
-                state, inbox, crash, viol, tele, prev_commit = carry
+                state, inbox, crash, viol, tele, bb, prev_commit = carry
                 state, inbox, _, crash, _, hit, alive, rst = pre_round(
                     state, inbox, None, crash, None, False)
                 pre = state
@@ -798,16 +902,21 @@ def build_chaos_epoch(
                 state, out = round_fn(
                     state, inbox, pl, prop_data, zp, z2, no, dt, keep
                 )
-                crash, viol = post_checks(pre, state, prev_commit, crash,
-                                          viol, hit)
+                crash, viol, gmask = post_checks(pre, state, prev_commit,
+                                                 crash, viol, hit)
                 tele = tele_step(tele, pre, state, alive, rst)
-                return (state, out, crash, viol, tele, state.commit), None
+                bb = bb_step(bb, pre, state, inbox, out, hit, alive, rst,
+                             gmask)
+                return (state, out, crash, viol, tele, bb,
+                        state.commit), None
 
-            (state, inbox, crash, viol, tele, prev_commit), _ = jax.lax.scan(
-                heal_body, (state, inbox, crash, viol, tele, prev_commit),
-                jnp.arange(rounds, dtype=jnp.int32),
-            )
-            return (state, inbox, held, crash, key, viol, tele,
+            (state, inbox, crash, viol, tele, bb, prev_commit), _ = \
+                jax.lax.scan(
+                    heal_body,
+                    (state, inbox, crash, viol, tele, bb, prev_commit),
+                    jnp.arange(rounds, dtype=jnp.int32),
+                )
+            return (state, inbox, held, crash, key, viol, tele, bb,
                     state.commit.sum() - commit0)
 
         def sample_keep(key, r):
@@ -828,8 +937,8 @@ def build_chaos_epoch(
 
         if with_delay:
             def body(carry, r):
-                state, inbox, held, crash, key, viol, tele, prev_commit = \
-                    carry
+                state, inbox, held, crash, key, viol, tele, bb, \
+                    prev_commit = carry
                 state, inbox, held, crash, key, hit, alive, rst = pre_round(
                     state, inbox, held, crash, key, True)
                 pre = state
@@ -847,22 +956,27 @@ def build_chaos_epoch(
                     kl, delay_p, (M, spec.K * M, C)
                 ) & (out.type != 0)
                 nxt, held2 = _merge_delayed(spec, out, held, delay)
-                crash, viol = post_checks(pre, state, prev_commit, crash,
-                                          viol, hit)
+                crash, viol, gmask = post_checks(pre, state, prev_commit,
+                                                 crash, viol, hit)
                 tele = tele_step(tele, pre, state, alive, rst)
-                return (state, nxt, held2, crash, key, viol, tele,
+                # `out` (pre-delay-split) is the honest send side; the
+                # wiped `inbox` is what this round actually consumed
+                bb = bb_step(bb, pre, state, inbox, out, hit, alive, rst,
+                             gmask)
+                return (state, nxt, held2, crash, key, viol, tele, bb,
                         state.commit), None
 
-            (state, inbox, held, crash, key, viol, tele, prev_commit), _ = \
-                jax.lax.scan(
-                    body,
-                    (state, inbox, held, crash, key, viol, tele,
-                     prev_commit),
-                    jnp.arange(rounds, dtype=jnp.int32),
-                )
+            (state, inbox, held, crash, key, viol, tele, bb,
+             prev_commit), _ = jax.lax.scan(
+                body,
+                (state, inbox, held, crash, key, viol, tele, bb,
+                 prev_commit),
+                jnp.arange(rounds, dtype=jnp.int32),
+            )
         else:
             def body(carry, r):
-                state, inbox, crash, key, viol, tele, prev_commit = carry
+                state, inbox, crash, key, viol, tele, bb, prev_commit = \
+                    carry
                 state, inbox, _, crash, key, hit, alive, rst = pre_round(
                     state, inbox, None, crash, key, True)
                 pre = state
@@ -876,19 +990,21 @@ def build_chaos_epoch(
                 state, out = round_fn(
                     state, inbox, pl, pd, pt, z2, no, dt, keep
                 )
-                crash, viol = post_checks(pre, state, prev_commit, crash,
-                                          viol, hit)
+                crash, viol, gmask = post_checks(pre, state, prev_commit,
+                                                 crash, viol, hit)
                 tele = tele_step(tele, pre, state, alive, rst)
-                return (state, out, crash, key, viol, tele,
+                bb = bb_step(bb, pre, state, inbox, out, hit, alive, rst,
+                             gmask)
+                return (state, out, crash, key, viol, tele, bb,
                         state.commit), None
 
-            (state, inbox, crash, key, viol, tele, prev_commit), _ = \
+            (state, inbox, crash, key, viol, tele, bb, prev_commit), _ = \
                 jax.lax.scan(
-                    body, (state, inbox, crash, key, viol, tele,
+                    body, (state, inbox, crash, key, viol, tele, bb,
                            prev_commit),
                     jnp.arange(rounds, dtype=jnp.int32),
                 )
-        return state, inbox, held, crash, key, viol, tele, \
+        return state, inbox, held, crash, key, viol, tele, bb, \
             state.commit.sum() - commit0
 
     return epoch
@@ -898,7 +1014,8 @@ def build_chaos_epoch(
 def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
                    faultless: bool, with_delay: bool = True,
                    with_crash: bool = False, with_member: bool = False,
-                   with_telemetry: bool = False):
+                   with_telemetry: bool = False,
+                   with_blackbox: bool = False):
     """One jitted epoch program per (cfg, spec, rounds, structure),
     shared across every run_chaos call and fault mix (probabilities are
     operands). Donation of the fleet-sized carries (state/inbox/held) is
@@ -929,13 +1046,20 @@ def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
             # plane is on: tele=None is the same None-donation hazard
             # as held.
             donate = donate + (8,)
+        if with_blackbox:
+            # same story for the black-box carry (arg 9): the ring leaf
+            # is [W, M, C] — fleet-scaled — and exclusively threaded;
+            # gate on the plane being on to avoid the None-donation
+            # hazard above.
+            donate = donate + (9,)
     else:
         donate = ()
     return jax.jit(
         build_chaos_epoch(cfg, spec, rounds, faultless=faultless,
                           with_delay=with_delay, with_crash=with_crash,
                           with_member=with_member,
-                          with_telemetry=with_telemetry),
+                          with_telemetry=with_telemetry,
+                          with_blackbox=with_blackbox),
         donate_argnums=donate,
     )
 
@@ -960,6 +1084,10 @@ def run_chaos(
     sync_dispatch: bool = False,
     telemetry: bool = False,
     telemetry_buckets: int = DEFAULT_BUCKETS,
+    telemetry_every: int = 1,
+    blackbox: bool = False,
+    blackbox_window: int = DEFAULT_WINDOW,
+    blackbox_k: int = 4,
 ) -> dict:
     """The tester's round loop (tester/cluster_run.go): alternate fault
     epochs and heal epochs, then verify recovery — every group ends with
@@ -990,6 +1118,19 @@ def run_chaos(
     summary with p50/p99 latencies, so a failing soak is diagnosable
     post-hoc epoch by epoch instead of from one end-state blob. State
     trajectories are bit-identical with telemetry on or off.
+    ``telemetry_every=N`` decimates the flight recorder to every Nth
+    epoch boundary (plus the final row) so multi-hour soaks don't grow
+    the timeline without bound.
+
+    ``blackbox=True`` rides the EventRing plane (models/blackbox.py)
+    through every epoch: each group keeps a [W, M] ring of bit-packed
+    per-round event words that FREEZES at that group's first violation,
+    so the preserved window ends at the offending round. After the run
+    the first ``blackbox_k`` violating group ids are reduced ON DEVICE
+    and only those groups' rings cross PCIe ([W, M, k], never
+    [W, M, C]); the report gains a ``forensics`` section with decoded
+    per-round per-member timelines (blackbox.forensics_report). State
+    trajectories are bit-identical with the ring on or off.
     """
     with_crash = crash_p > 0
     with_member = member_p > 0
@@ -1046,10 +1187,15 @@ def run_chaos(
 
     tele = (init_telemetry(spec, state, buckets=telemetry_buckets)
             if telemetry else None)
+    if telemetry_every < 1:
+        raise ValueError(f"telemetry_every must be >= 1, got "
+                         f"{telemetry_every}")
+    bb = empty_blackbox(spec, state, window=blackbox_window) \
+        if blackbox else None
     chaos = _epoch_program(cfg, spec, epoch_len, False, with_delay,
-                           with_crash, with_member, telemetry)
+                           with_crash, with_member, telemetry, blackbox)
     heal = _epoch_program(cfg, spec, heal_len, True, with_delay, with_crash,
-                          with_member, telemetry)
+                          with_member, telemetry, blackbox)
     dp = jnp.float32(drop_p)
     lp = jnp.float32(delay_p)
     pp = jnp.float32(partition_p)
@@ -1075,30 +1221,42 @@ def run_chaos(
     viol = zero_violations()
     commits = []
     timeline = []
+    rec = {"i": 0, "pending": None}
 
     def record(kind):
         # one small host transfer per epoch boundary: the flight
-        # recorder's cumulative snapshot (never inside the scan)
-        if telemetry:
-            timeline.append(flight_record(
-                tele, viol,
-                crash_state.metrics if with_recovery else None,
-                kind=kind))
+        # recorder's cumulative snapshot (never inside the scan).
+        # telemetry_every decimates multi-hour soaks — skipped rows
+        # remember their kind so the final boundary is never dropped
+        # (the counters are cumulative; the last row carries the run's
+        # end state).
+        if not telemetry:
+            return
+        i = rec["i"]
+        rec["i"] = i + 1
+        if i % telemetry_every:
+            rec["pending"] = kind
+            return
+        rec["pending"] = None
+        timeline.append(flight_record(
+            tele, viol,
+            crash_state.metrics if with_recovery else None,
+            kind=kind))
 
     done = 0
     fault_rounds = 0
     while done < rounds:
-        state, inbox, held, crash_state, key, viol, tele, dc = chaos(
+        state, inbox, held, crash_state, key, viol, tele, bb, dc = chaos(
             state, inbox, held, crash_state, key, prop_len, prop_data, viol,
-            tele, dp, lp, pp, cp, dr, kl, ca, mp, palette, sb, mb
+            tele, bb, dp, lp, pp, cp, dr, kl, ca, mp, palette, sb, mb
         )
         _sync(viol.multi_leader)
         done += epoch_len
         fault_rounds += epoch_len
         record("fault")
-        state, inbox, held, crash_state, key, viol, tele, dh = heal(
+        state, inbox, held, crash_state, key, viol, tele, bb, dh = heal(
             state, inbox, held, crash_state, key, prop_len, prop_data, viol,
-            tele, z, z, z, z, dr, kl, ca, z, palette, sb, mb
+            tele, bb, z, z, z, z, dr, kl, ca, z, palette, sb, mb
         )
         _sync(viol.multi_leader)
         done += heal_len
@@ -1115,13 +1273,19 @@ def run_chaos(
     for _ in range(6):
         if leaders() == C:
             break
-        state, inbox, held, crash_state, key, viol, tele, dh = heal(
+        state, inbox, held, crash_state, key, viol, tele, bb, dh = heal(
             state, inbox, held, crash_state, key, prop_len, prop_data, viol,
-            tele, z, z, z, z, dr, kl, ca, z, palette, sb, mb
+            tele, bb, z, z, z, z, dr, kl, ca, z, palette, sb, mb
         )
         done += heal_len
         record("heal")
         commits.append((0, int(dh)))
+    if telemetry and rec["pending"]:
+        # the run ended on a decimated boundary — flush the final row
+        timeline.append(flight_record(
+            tele, viol,
+            crash_state.metrics if with_recovery else None,
+            kind=rec["pending"]))
     has_leader = leaders()
     v = jax.device_get(viol)
     rep = {
@@ -1158,6 +1322,12 @@ def run_chaos(
             rep["telemetry"] = {"wrapped": True,
                                 "rounds": int(jax.device_get(tele.round))}
         rep["timeline"] = timeline
+    if blackbox:
+        # device-side reduction to the first-K offending group ids;
+        # only those groups' rings ([W, M, k]) cross PCIe — see
+        # blackbox.gather_forensics
+        rep["forensics"] = forensics_report(
+            bb.ring, bb.viol_groups, bb.viol_round, k=blackbox_k)
     if with_recovery:
         rep["config_aware"] = config_aware
         rep.update(crash_metrics_report(crash_state.metrics))
@@ -1177,6 +1347,10 @@ VIOLATION_KEYS = (
     "multi_leader", "hash_mismatch", "commit_regress",
     "lost_commit", "log_divergence", "term_regress",
 )
+# the black-box gmask encodes each violation kind at the bit position of
+# its key here; blackbox.py keeps its own literal copy to avoid a
+# models -> harness import — this pins the two in lockstep
+assert VIOLATION_KEYS == VIOLATION_BIT_NAMES
 
 
 def summarize_chaos(rep: dict, *, rounds: int, epoch_len: int,

@@ -34,6 +34,14 @@ def _jitted_tele_update(spec: Spec):
     return jax.jit(functools.partial(telemetry_update, spec))
 
 
+@functools.lru_cache(maxsize=16)
+def _jitted_bb_update(spec: Spec):
+    """One jitted black-box ring pass per Spec (models/blackbox.py)."""
+    from etcd_tpu.models.blackbox import blackbox_update
+
+    return jax.jit(functools.partial(blackbox_update, spec))
+
+
 class Cluster:
     def __init__(
         self,
@@ -45,6 +53,7 @@ class Cluster:
         learners=None,
         seed: int = 0,
         telemetry: bool = False,
+        blackbox: bool = False,
     ):
         spec = spec or Spec(M=n_members)
         # canonical lane padding: each distinct C value re-traces the whole
@@ -91,6 +100,20 @@ class Cluster:
 
             self.tele = init_telemetry(spec, self.eng.state)
             self._tele_step = _jitted_tele_update(spec)
+        # opt-in black-box event ring (models/blackbox.py): one packed
+        # per-round event word per member per lane, the device half of
+        # to_chrome_trace. Read-only over state, so stepping stays
+        # bit-identical; same packed_state restriction as telemetry.
+        self.bb = None
+        if blackbox:
+            if cfg.packed_state:
+                raise ValueError(
+                    "Cluster blackbox reads the unpacked fleet; "
+                    "construct with packed_state=False")
+            from etcd_tpu.models.blackbox import init_blackbox
+
+            self.bb = init_blackbox(spec, self.eng.state)
+            self._bb_step = _jitted_bb_update(spec)
         self._next_ctx = 1
         self._reset_inputs()
 
@@ -169,7 +192,11 @@ class Cluster:
         do_tick = np.zeros((self.spec.M, self._Cp), bool)
         if tick:
             do_tick[:, : self.C] = True
-        pre = self.eng.state if self.tele is not None else None
+        need_pre = self.tele is not None or self.bb is not None
+        pre = self.eng.state if need_pre else None
+        # the pre-step inbox is what this round consumes; the post-step
+        # inbox is what it sent — the ring wants both sides
+        pre_inbox = self.eng.inbox if self.bb is not None else None
         self.eng.step(
             prop_len=self._plen,
             prop_data=self._pdata,
@@ -180,6 +207,10 @@ class Cluster:
         )
         if self.tele is not None:
             self.tele = self._tele_step(self.tele, pre, self.eng.state)
+        if self.bb is not None:
+            self.bb = self._bb_step(self.bb, pre, self.eng.state,
+                                    inbox=pre_inbox,
+                                    outbox=self.eng.inbox)
         self._reset_inputs()
 
     def reset_telemetry(self) -> None:

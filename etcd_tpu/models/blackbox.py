@@ -1,0 +1,473 @@
+"""Black-box forensics plane: per-group event rings + post-mortem decode.
+
+PR 9's telemetry made the fleet *measurable* (aggregate histograms, a
+flight recorder, /metrics) but not *diagnosable*: when a recovery
+checker fires at 262k groups, cumulative counters cannot say WHICH group
+failed or what its members did in the rounds before the violation. This
+module is the aviation-style answer — a black-box flight recorder:
+
+  * :class:`EventRing` — a pytree riding BESIDE the fleet state exactly
+    like FleetTelemetry: ``ring[W, M, C]`` holds one bit-packed i32
+    EVENT WORD per (round window slot, member, group), where W is a
+    build-time window (~32 rounds). Each word packs the member's role,
+    role/term transitions, commit/applied frontier deltas, per-class
+    message send/receive activity, crash/restart/down flags, conf-change
+    applies and snapshot installs — everything needed to read a per-round
+    timeline of a group's last W rounds.
+  * :func:`blackbox_update` — ONE pure read-only reduction of (pre,
+    post) round states plus the consumed/emitted wire; shared by the
+    metered round (models/metrics.py build_metered_round), the chaos
+    epoch scan (harness/chaos.py) and the serving Cluster, so a word
+    means the same thing everywhere. It never feeds back: a ring-on
+    round is bit-identical in state AND wire to the ring-off round
+    (tests/test_telemetry_blackbox.py proves it, incl. packed_state /
+    sparse_outbox and the crash-chaos epoch program).
+  * on-violation extraction: :func:`first_k_offenders` +
+    :func:`gather_forensics` reduce the per-group violation masks ON
+    DEVICE to the first-K offending group ids and gather ONLY those
+    groups' rings across PCIe (a [W, M, K] transfer, never [W, M, C]);
+    :func:`forensics_report` host-decodes them into per-round,
+    per-member human-readable timelines for chaos_run.py's JSON.
+  * :func:`to_chrome_trace` — Chrome trace-event JSON (one track per
+    member for ring timelines, one track per request for host Trace
+    spans) loadable in Perfetto — the repo's first correlated
+    device-round <-> host-request view.
+
+All three PR-9 hardening lessons apply: init gives every leaf its OWN
+buffer (the chaos programs donate the carry; XLA rejects one buffer at
+two donated positions), only device-reduced narrow slices ever cross
+PCIe, and decoded output is RFC-8259-clean JSON.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from etcd_tpu.models.state import NodeState
+from etcd_tpu.types import (
+    MSG_APP,
+    MSG_APP_RESP,
+    MSG_HEARTBEAT,
+    MSG_HEARTBEAT_RESP,
+    MSG_HUP,
+    MSG_PRE_VOTE,
+    MSG_PRE_VOTE_RESP,
+    MSG_SNAP,
+    MSG_SNAP_STATUS,
+    MSG_TIMEOUT_NOW,
+    MSG_TRANSFER_LEADER,
+    MSG_VOTE,
+    MSG_VOTE_RESP,
+    ROLE_LEADER,
+    Spec,
+)
+
+# ring window (rounds of history per group); a build-time knob like the
+# telemetry bucket count — small enough that ring[W, M, C] stays a
+# minor fraction of the log ([L, E, C] i32) at the bench geometries
+DEFAULT_WINDOW = 32
+
+# ---------------------------------------------------------------------------
+# event-word bit layout (i32; bit 31 stays clear so words are always
+# non-negative — decode_word never has to think about sign extension)
+#
+#   bits  0-1   role after the round (ROLE_* 0..3)
+#   bit   2     role transition (post.role != pre.role)
+#   bits  3-5   term delta this round, clamped to [0, 7]
+#   bits  6-8   commit frontier delta, clamped to [0, 7]
+#   bits  9-11  applied frontier delta, clamped to [0, 7]
+#   bit   12    snapshot install (applied jump > Spec.A — the same sound
+#               detector as telemetry/build_kv_round)
+#   bit   13    conf-change apply (any applied config mask changed)
+#   bit   14    crashed this round (chaos tier)
+#   bit   15    restart completed this round (chaos tier)
+#   bit   16    down this round (chaos tier)
+#   bits 17-20  message classes SENT (bitmask: append/election/heartbeat/
+#               other — see MSG_CLASSES)
+#   bits 21-24  message classes RECEIVED (same bitmask)
+#   bits 25-27  messages sent, clamped to [0, 7]
+#   bits 28-30  messages received, clamped to [0, 7]
+# ---------------------------------------------------------------------------
+
+ROLE_NAMES = ("follower", "pre-candidate", "candidate", "leader")
+MSG_CLASSES = ("append", "election", "heartbeat", "other")
+
+# message-type -> class id (1-based; 0 = empty slot). Index by msg type.
+_CLASS_APPEND = (MSG_APP, MSG_APP_RESP, MSG_SNAP, MSG_SNAP_STATUS)
+_CLASS_ELECT = (MSG_VOTE, MSG_VOTE_RESP, MSG_PRE_VOTE, MSG_PRE_VOTE_RESP,
+                MSG_TIMEOUT_NOW, MSG_TRANSFER_LEADER, MSG_HUP)
+_CLASS_HEARTBEAT = (MSG_HEARTBEAT, MSG_HEARTBEAT_RESP)
+_N_MSG_TYPES = 18
+
+
+def _class_table() -> np.ndarray:
+    t = np.zeros((_N_MSG_TYPES,), np.int32)
+    t[list(_CLASS_APPEND)] = 1
+    t[list(_CLASS_ELECT)] = 2
+    t[list(_CLASS_HEARTBEAT)] = 3
+    # every remaining nonzero type (prop, read-index, unreachable, ...)
+    t[1:][t[1:] == 0] = 4
+    return t
+
+
+_CLASS_TABLE = _class_table()
+
+
+class EventRing(struct.PyTreeNode):
+    """Device-resident event ring. ``ring[W, M, C]`` i32 event words for
+    the last W rounds (slot = round % W); ``round`` counts rounds
+    observed. Both are read-only reductions of the round — the ring
+    never feeds back into state."""
+
+    round: jnp.ndarray  # i32 rounds observed
+    ring: jnp.ndarray   # [W, M, C] i32 bit-packed event words
+
+
+def init_blackbox(spec: Spec, state: NodeState,
+                  window: int = DEFAULT_WINDOW) -> EventRing:
+    """Ring attached to a live (unpacked) fleet. Every leaf gets its OWN
+    freshly-computed buffer, never an alias of a state leaf: the chaos
+    epoch programs donate the whole carry on accelerators and XLA
+    rejects one buffer at two donated positions in a single Execute
+    (the empty_crash_state hazard class; tests assert distinctness)."""
+    if not 2 <= window <= 256:
+        raise ValueError(f"blackbox window={window} outside [2, 256]")
+    C = state.term.shape[-1]
+    return EventRing(
+        round=jnp.zeros((), jnp.int32),
+        ring=jnp.zeros((window, spec.M, C), jnp.int32),
+    )
+
+
+def _msg_activity(spec: Spec, msg) -> tuple:
+    """Per-member message activity from a wire pytree in either storage
+    form: (sent_count, recv_count, sent_cls, recv_cls), each [M, C]
+    (class leaves [4, M, C] bool). Senders are attributed by the ``frm``
+    field (exact in the flat form where axis 0 is the sender, and in
+    the compacted carry form where it is not); receivers by the flat
+    middle-axis layout slot*M + to shared by both forms."""
+    M = spec.M
+    t = msg.type.astype(jnp.int32)
+    live = t != 0
+    cls = jnp.asarray(_CLASS_TABLE)[jnp.clip(t, 0, _N_MSG_TYPES - 1)]
+    mem = jnp.arange(M, dtype=jnp.int32)
+    frm = msg.frm.astype(jnp.int32)
+    to_ids = jnp.arange(t.shape[1], dtype=jnp.int32) % M         # [S]
+    # [A, S, M, C] bool temporaries — A*S is tens of slots at the chaos
+    # specs, so these stay small next to the log
+    is_sender = live[:, :, None, :] & (frm[:, :, None, :] == mem[None, None, :, None])
+    is_recv = live[:, :, None, :] & (to_ids[None, :, None, None] == mem[None, None, :, None])
+    sent = is_sender.sum(axis=(0, 1)).astype(jnp.int32)          # [M, C]
+    recv = is_recv.sum(axis=(0, 1)).astype(jnp.int32)
+    sent_cls = jnp.stack([
+        (is_sender & (cls[:, :, None, :] == g)).any(axis=(0, 1))
+        for g in range(1, 5)])                                   # [4, M, C]
+    recv_cls = jnp.stack([
+        (is_recv & (cls[:, :, None, :] == g)).any(axis=(0, 1))
+        for g in range(1, 5)])
+    return sent, recv, sent_cls, recv_cls
+
+
+def _event_word(spec: Spec, pre: NodeState, post: NodeState, inbox, outbox,
+                crashed, restarted, down) -> jnp.ndarray:
+    """One round's [M, C] bit-packed event words (layout above)."""
+    i32 = jnp.int32
+    w = post.role.astype(i32) & 0x3
+    w = w | ((post.role != pre.role).astype(i32) << 2)
+    w = w | (jnp.clip(post.term - pre.term, 0, 7).astype(i32) << 3)
+    w = w | (jnp.clip(post.commit - pre.commit, 0, 7).astype(i32) << 6)
+    dap = post.applied - pre.applied
+    w = w | (jnp.clip(dap, 0, 7).astype(i32) << 9)
+    w = w | ((dap > spec.A).astype(i32) << 12)
+    cc = ((pre.voters != post.voters)
+          | (pre.voters_out != post.voters_out)
+          | (pre.learners != post.learners)
+          | (pre.learners_next != post.learners_next)).any(axis=1)
+    w = w | (cc.astype(i32) << 13)
+    if crashed is not None:
+        w = w | (crashed.astype(i32) << 14)
+    if restarted is not None:
+        w = w | (restarted.astype(i32) << 15)
+    if down is not None:
+        w = w | (down.astype(i32) << 16)
+    if outbox is not None:
+        sent, _, sent_cls, _ = _msg_activity(spec, outbox)
+        bits = jnp.zeros_like(sent)
+        for g in range(4):
+            bits = bits | (sent_cls[g].astype(i32) << (17 + g))
+        w = w | bits | (jnp.clip(sent, 0, 7).astype(i32) << 25)
+    if inbox is not None:
+        _, recv, _, recv_cls = _msg_activity(spec, inbox)
+        bits = jnp.zeros_like(recv)
+        for g in range(4):
+            bits = bits | (recv_cls[g].astype(i32) << (21 + g))
+        w = w | bits | (jnp.clip(recv, 0, 7).astype(i32) << 28)
+    return w
+
+
+def blackbox_update(spec: Spec, bb: EventRing, pre: NodeState,
+                    post: NodeState, inbox=None, outbox=None, crashed=None,
+                    restarted=None, down=None, write_mask=None) -> EventRing:
+    """One round's ring pass: pure reductions over the (unpacked)
+    pre/post states and the consumed (``inbox``) / emitted (``outbox``)
+    wire — reads only, so fusing it into a round program cannot perturb
+    the state or wire trajectory.
+
+    ``crashed``/``restarted``/``down`` ([M, C] bool or None) come from
+    the chaos tier's crash bookkeeping; None compiles those flag lanes
+    out. ``write_mask`` ([C] bool or None) gates which groups still
+    record: the chaos tier freezes a group's ring at its first
+    violation (recording stops at the crash, aviation-style), so the
+    preserved window is the W rounds UP TO the violation rather than
+    the end of the run."""
+    W = bb.ring.shape[0]
+    word = _event_word(spec, pre, post, inbox, outbox, crashed, restarted,
+                       down)
+    sel = jnp.arange(W, dtype=jnp.int32)[:, None, None] == bb.round % W
+    if write_mask is not None:
+        sel = sel & write_mask[None, None, :]
+    return EventRing(round=bb.round + 1,
+                     ring=jnp.where(sel, word[None], bb.ring))
+
+
+# ---------------------------------------------------------------------------
+# device-side on-violation reduction
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def first_k_offenders(mask: jnp.ndarray, k: int) -> jnp.ndarray:
+    """First-K set group ids of a [C] bool mask, ON DEVICE: i32[k] ids
+    in ascending order, padded with the sentinel C when fewer than k
+    groups are set. The sort runs over one [C] i32 lane — never a
+    fleet-scaled transfer."""
+    C = mask.shape[0]
+    idx = jnp.where(mask, jnp.arange(C, dtype=jnp.int32), C)
+    return jnp.sort(idx)[:k]
+
+
+def gather_forensics(ring: EventRing, viol_groups: jnp.ndarray,
+                     viol_round: jnp.ndarray, k: int) -> dict:
+    """Reduce + gather on device, then ONE narrow host transfer: the
+    first-K offending group ids and ONLY those groups' ring lanes
+    ([W, M, k] — the full [W, M, C] ring never crosses PCIe). Returns
+    numpy arrays keyed ids/rings/bits/viol_round/total/round; callers
+    (and the device-reduction acceptance test) can check rings.shape[-1]
+    == k directly."""
+    C = viol_groups.shape[0]
+    ids = first_k_offenders(viol_groups != 0, k)
+    safe = jnp.minimum(ids, C - 1)  # sentinel lanes gather a dummy group
+    return jax.device_get({
+        "ids": ids,
+        "rings": ring.ring[:, :, safe],
+        "bits": viol_groups[safe],
+        "viol_round": viol_round[safe],
+        "total": (viol_groups != 0).sum().astype(jnp.int32),
+        "round": ring.round,
+    })
+
+
+# ---------------------------------------------------------------------------
+# host-side decode
+# ---------------------------------------------------------------------------
+
+
+def decode_word(w: int) -> dict:
+    """One event word -> a plain field dict (layout above)."""
+    w = int(w)
+    sent_cls = [MSG_CLASSES[g] for g in range(4) if (w >> (17 + g)) & 1]
+    recv_cls = [MSG_CLASSES[g] for g in range(4) if (w >> (21 + g)) & 1]
+    return {
+        "role": ROLE_NAMES[w & 0x3],
+        "role_change": bool((w >> 2) & 1),
+        "term_delta": (w >> 3) & 0x7,
+        "commit_delta": (w >> 6) & 0x7,
+        "applied_delta": (w >> 9) & 0x7,
+        "snapshot_install": bool((w >> 12) & 1),
+        "conf_change": bool((w >> 13) & 1),
+        "crashed": bool((w >> 14) & 1),
+        "restarted": bool((w >> 15) & 1),
+        "down": bool((w >> 16) & 1),
+        "sent": sent_cls,
+        "recv": recv_cls,
+        "sent_count": (w >> 25) & 0x7,
+        "recv_count": (w >> 28) & 0x7,
+    }
+
+
+def word_events(d: dict) -> list:
+    """Human-readable event strings for one decoded word (the forensics
+    timeline's per-member lines)."""
+    ev = []
+    if d["crashed"]:
+        ev.append("crash")
+    if d["restarted"]:
+        ev.append("restart")
+    if d["down"]:
+        ev.append("down")
+    if d["role_change"]:
+        ev.append("became-leader" if d["role"] == ROLE_NAMES[ROLE_LEADER]
+                  else f"became-{d['role']}")
+    if d["term_delta"]:
+        ev.append(f"term+{d['term_delta']}")
+    if d["snapshot_install"]:
+        ev.append("snap-install")
+    elif d["applied_delta"]:
+        ev.append(f"applied+{d['applied_delta']}")
+    if d["commit_delta"]:
+        ev.append(f"commit+{d['commit_delta']}")
+    if d["conf_change"]:
+        ev.append("conf-change")
+    if d["sent"]:
+        ev.append("sent:" + "|".join(d["sent"]))
+    if d["recv"]:
+        ev.append("recv:" + "|".join(d["recv"]))
+    return ev
+
+
+def ring_timeline(ring_wm: np.ndarray, end_round: int) -> list:
+    """Decode one group's ring lanes ([W, M] i32) into per-round rows.
+    ``end_round`` is the LAST round the ring recorded for this group
+    (the violation round for a frozen group, rounds_observed - 1
+    otherwise); the ring covers rounds [end_round - W + 1, end_round]
+    clipped at 0."""
+    W, M = ring_wm.shape
+    rows = []
+    for r in range(max(0, end_round - W + 1), end_round + 1):
+        members = []
+        for m in range(M):
+            d = decode_word(ring_wm[r % W, m])
+            members.append({"member": m, "role": d["role"],
+                            "word": int(ring_wm[r % W, m]),
+                            "events": word_events(d)})
+        rows.append({"round": r, "members": members})
+    return rows
+
+
+# bit order matches harness.chaos.VIOLATION_KEYS (kept literal here to
+# avoid a models -> harness import cycle; chaos.py asserts the order)
+VIOLATION_BIT_NAMES = (
+    "multi_leader", "hash_mismatch", "commit_regress",
+    "lost_commit", "log_divergence", "term_regress",
+)
+
+
+def violation_names(bits: int) -> list:
+    return [n for i, n in enumerate(VIOLATION_BIT_NAMES)
+            if (int(bits) >> i) & 1]
+
+
+def forensics_report(ring: EventRing, viol_groups: jnp.ndarray,
+                     viol_round: jnp.ndarray, k: int = 4) -> dict:
+    """The chaos post-mortem: device-reduce to the first-K offending
+    groups, gather only their rings, and host-decode each into a
+    per-round, per-member human-readable timeline. A persist-nothing
+    run's report pinpoints the lost-commit round
+    (first_violation_round) with the crash/role/commit events of the
+    rounds leading up to it."""
+    g = gather_forensics(ring, viol_groups, viol_round, k)
+    W = ring.ring.shape[0]
+    C = viol_groups.shape[0]
+    rounds = int(g["round"])
+    captured = []
+    for i, gid in enumerate(np.asarray(g["ids"])):
+        if int(gid) >= C:
+            break  # sentinel: fewer than k offenders
+        vr = int(g["viol_round"][i])
+        end = vr if vr >= 0 else rounds - 1
+        captured.append({
+            "group": int(gid),
+            "violations": violation_names(int(g["bits"][i])),
+            "first_violation_round": vr,
+            "timeline": ring_timeline(np.asarray(g["rings"][:, :, i]), end),
+        })
+    return {
+        "window": W,
+        "rounds_observed": rounds,
+        "groups_violating": int(g["total"]),
+        "captured": captured,
+    }
+
+
+def ring_capture(ring: EventRing, group_ids) -> list:
+    """Decode live (non-violation) ring lanes for the given groups — the
+    serving path's view for to_chrome_trace. Gathers only the requested
+    groups' lanes ([W, M, len(ids)]) across PCIe."""
+    ids = jnp.asarray(list(group_ids), jnp.int32)
+    g = jax.device_get({"rings": ring.ring[:, :, ids], "round": ring.round})
+    end = int(g["round"]) - 1
+    return [{"group": int(gid),
+             "timeline": ring_timeline(np.asarray(g["rings"][:, :, i]), end)}
+            for i, gid in enumerate(group_ids)]
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export (Perfetto / chrome://tracing)
+# ---------------------------------------------------------------------------
+
+# host request spans land on their own synthetic process id, far from
+# any plausible group id
+HOST_PID = 1 << 20
+
+
+def to_chrome_trace(captures=None, spans=None, round_us: int = 1000) -> dict:
+    """Chrome trace-event JSON (the {"traceEvents": [...]} form Perfetto
+    and chrome://tracing load): one track per MEMBER for device ring
+    timelines (pid = group id, tid = member id; each round is a
+    ``round_us``-microsecond complete event named by its decoded
+    events) and one track per REQUEST for host Trace spans (pid =
+    HOST_PID, tid = request index; the span plus one child slice per
+    trace step). ``captures`` is forensics_report()["captured"] or
+    ring_capture() output; ``spans`` is a list of Trace.to_span()
+    dicts. Dump with json.dump and load the file at ui.perfetto.dev."""
+    events = []
+    for cap in captures or []:
+        g = int(cap["group"])
+        events.append({"ph": "M", "name": "process_name", "pid": g,
+                       "tid": 0, "args": {"name": f"raft group {g} "
+                                                  "(device rounds)"}})
+        seen_members = set()
+        for row in cap["timeline"]:
+            ts = row["round"] * round_us
+            for ent in row["members"]:
+                m = int(ent["member"])
+                if m not in seen_members:
+                    seen_members.add(m)
+                    events.append({"ph": "M", "name": "thread_name",
+                                   "pid": g, "tid": m,
+                                   "args": {"name": f"member {m}"}})
+                name = ", ".join(ent["events"]) or ent["role"]
+                events.append({
+                    "ph": "X", "cat": "device", "name": name,
+                    "pid": g, "tid": m, "ts": ts, "dur": round_us,
+                    "args": {"round": row["round"], "role": ent["role"],
+                             "word": ent["word"]},
+                })
+    if spans:
+        events.append({"ph": "M", "name": "process_name", "pid": HOST_PID,
+                       "tid": 0, "args": {"name": "host requests"}})
+        t0 = min(s["start"] for s in spans)
+        for i, s in enumerate(spans):
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": HOST_PID, "tid": i,
+                           "args": {"name": f"req {i}: {s['op']}"}})
+            base = (s["start"] - t0) * 1e6
+            events.append({
+                "ph": "X", "cat": "host", "name": s["op"],
+                "pid": HOST_PID, "tid": i, "ts": base,
+                "dur": s["dur"] * 1e6, "args": dict(s.get("fields", {})),
+            })
+            prev = 0.0
+            for st in s.get("steps", []):
+                events.append({
+                    "ph": "X", "cat": "host", "name": st["msg"],
+                    "pid": HOST_PID, "tid": i, "ts": base + prev * 1e6,
+                    "dur": max(st["ts"] - prev, 0.0) * 1e6,
+                    "args": dict(st.get("fields", {})),
+                })
+                prev = st["ts"]
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

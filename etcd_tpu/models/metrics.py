@@ -138,16 +138,20 @@ def crash_metrics_report(m: CrashMetrics) -> dict:
 
 
 def build_metered_round(cfg: RaftConfig, spec: Spec,
-                        with_telemetry: bool = False):
-    """Round program with fused metric (and optional telemetry) updates
-    — the ONE instrumented-round builder every observability consumer
-    shares (ISSUE 9 unification).
+                        with_telemetry: bool = False,
+                        with_blackbox: bool = False):
+    """Round program with fused metric (and optional telemetry /
+    black-box ring) updates — the ONE instrumented-round builder every
+    observability consumer shares (ISSUE 9 unification).
 
     Returns fn(state, inbox, prop_len, prop_data, prop_type, ri_ctx,
     do_hup, do_tick, keep_mask, metrics) -> (state, inbox, metrics);
     with_telemetry adds a trailing FleetTelemetry argument and result
     (models/telemetry.py — per-group lanes + latency histograms), fused
     into the same program by the same read-only reductions.
+    with_blackbox adds a trailing EventRing argument and result after it
+    (models/blackbox.py — per-round bit-packed event words over the
+    same pre/post views plus the consumed/emitted wire).
 
     The metric math is a handful of elementwise reductions over state
     the round already touches — XLA fuses them into the same program, so
@@ -169,7 +173,7 @@ def build_metered_round(cfg: RaftConfig, spec: Spec,
 
     def metered(state: NodeState, inbox, prop_len, prop_data, prop_type,
                 ri_ctx, do_hup, do_tick, keep_mask, metrics: FleetMetrics,
-                telemetry=None):
+                telemetry=None, blackbox=None):
         pre = unp(state)
         was_leader = pre.role == ROLE_LEADER
         commit0, applied0 = pre.commit, pre.applied
@@ -206,7 +210,20 @@ def build_metered_round(cfg: RaftConfig, spec: Spec,
             from etcd_tpu.models.telemetry import telemetry_update
 
             telemetry = telemetry_update(spec, telemetry, pre, post)
+        if with_blackbox:
+            from etcd_tpu.models.blackbox import blackbox_update
+
+            # the consumed wire is this round's receive side, the fresh
+            # wire its send side — both read-only views the round
+            # already produced
+            blackbox = blackbox_update(spec, blackbox, pre, post,
+                                       inbox=inbox, outbox=next_inbox)
+        if with_telemetry and with_blackbox:
+            return state, next_inbox, metrics, telemetry, blackbox
+        if with_telemetry:
             return state, next_inbox, metrics, telemetry
+        if with_blackbox:
+            return state, next_inbox, metrics, blackbox
         return state, next_inbox, metrics
 
     return metered

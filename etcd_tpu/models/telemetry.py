@@ -484,12 +484,14 @@ def prometheus_parse(text: str) -> dict:
 
 
 def server_metric_families(summary: dict, telemetry: dict | None = None,
-                           contention=None) -> list:
+                           contention=None, slow: dict | None = None) -> list:
     """The /metrics endpoint's family list: etcd-reference metric names
     over the fleet summary (models/metrics.py fleet_summary), the
     telemetry report's latency histograms when the serving cluster
     carries a telemetry plane, and the legacy etcd_tpu_* gauges the
-    earlier evidence runs scraped."""
+    earlier evidence runs scraped. ``slow`` carries the kvserver's
+    slow-request counters ({"slow_apply_total", "slow_read_indexes_
+    total"}) — the reference's applyTook/slowReadIndex signals."""
     g = "gauge"
 
     def plain(v):
@@ -556,6 +558,17 @@ def server_metric_families(summary: dict, telemetry: dict | None = None,
             "etcd_tpu_snapshot_installs_total", "counter",
             "Snapshot installs observed (applied-jump detector).",
             plain(telemetry["snapshot_installs_total"])))
+    if slow is not None:
+        fams.append((
+            "etcd_server_slow_apply_total", "counter",
+            "The total number of slow apply requests "
+            "(likely overloaded from slow disk).",
+            plain(int(slow.get("slow_apply_total", 0)))))
+        fams.append((
+            "etcd_server_slow_read_indexes_total", "counter",
+            "The total number of pending read indexes not in sync with "
+            "leader's or timed out read index requests.",
+            plain(int(slow.get("slow_read_indexes_total", 0)))))
     if contention is not None:
         fams.append((
             "etcd_tpu_ticker_late_total", "counter",

@@ -18,6 +18,7 @@ wait-map (pkg/wait/wait.go:33-41 analog) to the blocked caller.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any
 
 import numpy as np
@@ -169,13 +170,17 @@ class EtcdCluster:
         apply_plane: str = "host",
         kv_keys: int = 64,
         telemetry: bool = False,
+        blackbox: bool = False,
     ):
         # telemetry=True attaches the fleet telemetry plane to the
         # backing Cluster (harness/cluster.py): /metrics then serves the
-        # latency-histogram families (v3rpc) from it. Ignored when an
-        # explicit `cluster` is injected — its owner decides.
+        # latency-histogram families (v3rpc) from it; blackbox=True adds
+        # the per-round EventRing (models/blackbox.py), exportable as a
+        # Chrome trace alongside the host request spans below. Ignored
+        # when an explicit `cluster` is injected — its owner decides.
         self.cl = cluster or Cluster(n_members=n_members,
-                                     telemetry=telemetry)
+                                     telemetry=telemetry,
+                                     blackbox=blackbox)
         # acknowledged ⇒ on disk: fsync the members' backends before a
         # propose returns (the reference gets this from WAL MustSync
         # before the Ready is acked, storage.go; here the device ring
@@ -198,6 +203,15 @@ class EtcdCluster:
         # armed by embed's ticker (utils/contention.py): late host ticks
         # are the TPU analog of the reference's late leader heartbeats
         self.contention = None
+        # slow-request counters served by /metrics
+        # (etcd_server_slow_apply_total / etcd_server_slow_read_indexes_
+        # total — the reference's applyTook>warningApplyDuration and
+        # slowReadIndex signals, server.go / v3_server.go)
+        self.slow_apply_total = 0
+        self.slow_read_index_total = 0
+        # completed request spans (Trace.to_span dicts) for the Chrome
+        # trace exporter; bounded ring so long-lived servers don't grow
+        self.req_spans: list[dict] = []
         # per-member binary-version overrides for mixed-version fleets
         # (the reference's rolling binary swap); applies at construction
         # AND at restart-from-disk (see _member_from_backend)
@@ -784,10 +798,21 @@ class EtcdCluster:
         if req is None:
             return  # foreign/unknown ref (e.g. replay after restart)
         req["_index"] = index  # for payload-table GC once all members apply
+        t0 = time.perf_counter()
         try:
             res = self._dispatch(m, ms, req)
         except (ServerError, Exception) as e:  # applier must never crash
             res = e
+        dt = time.perf_counter() - t0
+        if dt > self.SLOW_APPLY_THRESHOLD_S:
+            # the applyTook > warningApplyDuration signal
+            # (etcdserver/server.go) behind etcd_server_slow_apply_total
+            self.slow_apply_total += 1
+            from etcd_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "slow apply: member=%d kind=%s index=%d took %.3fs",
+                m, req.get("kind", "?"), index, dt)
         # only the serving member's wait-map entry has a consumer; recording
         # results on every member would leak one entry per request per peer
         if m == req.get("_serve_m"):
@@ -1023,12 +1048,34 @@ class EtcdCluster:
     # log-if-slower-than threshold for request traces (the
     # warningApplyDuration dump rule, v3_server.go:602-610), seconds
     TRACE_THRESHOLD_S = 0.5
+    # per-entry apply threshold feeding etcd_server_slow_apply_total
+    # (applyTook > warningApplyDuration, etcdserver/server.go)
+    SLOW_APPLY_THRESHOLD_S = 0.1
+    # read-index wait threshold feeding
+    # etcd_server_slow_read_indexes_total (slowReadIndex,
+    # v3_server.go linearizableReadLoop)
+    SLOW_READ_INDEX_THRESHOLD_S = 0.5
+    # how many completed request spans to keep for to_chrome_trace
+    REQ_SPAN_CAP = 256
 
-    def _propose(self, req: dict, member: int | None = None) -> Any:
+    def _record_span(self, trace) -> None:
+        """Retire a finished Trace into the bounded span buffer that
+        blackbox.to_chrome_trace exports (host-request tracks)."""
+        if trace is None or trace.is_empty:
+            return
+        self.req_spans.append(trace.to_span())
+        if len(self.req_spans) > self.REQ_SPAN_CAP:
+            del self.req_spans[: len(self.req_spans) - self.REQ_SPAN_CAP]
+
+    def _propose(self, req: dict, member: int | None = None,
+                 trace=None) -> Any:
         """processInternalRaftRequestOnce (v3_server.go:643-704)."""
         from etcd_tpu.utils.trace import Field, Trace
 
-        trace = Trace(req.get("kind", "?"), Field("member", member))
+        if trace is None or trace.is_empty:
+            trace = Trace(req.get("kind", "?"), Field("member", member))
+        else:
+            trace.add_field(Field("member", member))
         lead = self.ensure_leader()
         at = member if member is not None else lead
         # backpressure: commit-apply gap (v3_server.go:644-648)
@@ -1058,6 +1105,7 @@ class EtcdCluster:
             raise ErrTimeout(req["kind"])
         finally:
             trace.log_if_long(self.TRACE_THRESHOLD_S)
+            self._record_span(trace)
 
     def _header(self, m: int) -> ResponseHeader:
         s = self.cl.s
@@ -1070,63 +1118,86 @@ class EtcdCluster:
 
     # ------------------------------------------------------------- public KV
     def put(self, key: bytes, value: bytes, lease: int = 0,
-            prev_kv: bool = False, token: str | None = None):
+            prev_kv: bool = False, token: str | None = None, trace=None):
         self._authz(token, key, None, write=True)
         res = self._propose(
             {"kind": "put", "key": key, "value": value, "lease": lease,
-             "prev_kv": prev_kv}
+             "prev_kv": prev_kv}, trace=trace
         )
         self._maybe_raise_nospace()
         return res
 
     def delete_range(self, key: bytes, range_end: bytes | None = None,
-                     prev_kv: bool = False, token: str | None = None):
+                     prev_kv: bool = False, token: str | None = None,
+                     trace=None):
         self._authz(token, key, range_end, write=True)
         return self._propose(
             {"kind": "delete_range", "key": key, "range_end": range_end,
-             "prev_kv": prev_kv}
+             "prev_kv": prev_kv}, trace=trace
         )
 
     def txn(self, compare: list[Compare], success: list[Op],
-            failure: list[Op] | None = None, token: str | None = None):
+            failure: list[Op] | None = None, token: str | None = None,
+            trace=None):
         for cmp_ in compare:
             self._authz(token, cmp_.key, None, write=False)
         for op in success + (failure or []):
             self._authz(token, op.key, op.range_end, write=op.type != "range")
         return self._propose(
             {"kind": "txn", "compare": compare, "success": success,
-             "failure": failure or []}
+             "failure": failure or []}, trace=trace
         )
 
     def range(self, key: bytes, range_end: bytes | None = None, rev: int = 0,
               limit: int = 0, serializable: bool = False, member: int | None = None,
-              count_only: bool = False, token: str | None = None):
+              count_only: bool = False, token: str | None = None, trace=None):
         """Range: linearizable by default via ReadIndex barrier
         (v3_server.go:95-133,709)."""
         from etcd_tpu.utils.trace import Field, Trace
 
-        trace = Trace("range", Field("serializable", serializable))
+        if trace is None or trace.is_empty:
+            trace = Trace("range", Field("serializable", serializable))
+        else:
+            trace.add_field(Field("serializable", serializable))
         self._authz(token, key, range_end, write=False)
         m = member if member is not None else self.ensure_leader()
         if not serializable:
-            self.linearizable_read_notify(m)
+            self.linearizable_read_notify(m, trace=trace)
             trace.step("read index confirmed; applied caught up")
         kvs, count, used = self.members[m].store.kv.range(
             key, range_end, rev, limit, count_only
         )
         trace.step("range keys from mvcc", Field("count", count))
         trace.log_if_long(self.TRACE_THRESHOLD_S)
+        self._record_span(trace)
         return {"kvs": kvs, "count": count, "rev": used,
                 "header": self._header(m)}
 
     def compact(self, rev: int):
         return self._propose({"kind": "compact", "rev": rev})
 
-    def linearizable_read_notify(self, member: int) -> None:
+    def linearizable_read_notify(self, member: int, trace=None) -> None:
         """linearizableReadLoop round (v3_server.go:709-879): ReadIndex, then
-        wait until applied >= read index."""
+        wait until applied >= read index. A wait past
+        SLOW_READ_INDEX_THRESHOLD_S (or a timeout) counts into
+        etcd_server_slow_read_indexes_total, the reference's slowReadIndex
+        signal."""
+        t0 = time.perf_counter()
+
+        def _settle(ok: bool) -> None:
+            dt = time.perf_counter() - t0
+            if not ok or dt > self.SLOW_READ_INDEX_THRESHOLD_S:
+                self.slow_read_index_total += 1
+                from etcd_tpu.utils.logging import get_logger
+
+                get_logger().warning(
+                    "slow read index: member=%d waited %.3fs (%s)",
+                    member, dt, "confirmed" if ok else "timed out")
+
         self.ensure_leader()
         ctx = self.cl.read_index(member, c=self.c)
+        if trace is not None:
+            trace.step("read index requested")
         for _ in range(self.MAX_APPLY_WAIT_ROUNDS):
             self.step()
             rs_ctx = np.asarray(self.cl.s.rs_ctx[member, ..., self.c])
@@ -1145,7 +1216,9 @@ class EtcdCluster:
                 )
                 while self.members[member].applied_index < need:
                     self.step()
+                _settle(True)
                 return
+        _settle(False)
         raise ErrTimeout("read index")
 
     # ---------------------------------------------------------------- leases

@@ -31,6 +31,7 @@ from etcd_tpu.concurrency import Election, Mutex, Session
 from etcd_tpu.server.kvserver import Compare, EtcdCluster, Op, ServerError
 
 from etcd_tpu.server.version import MIN_CLUSTER_VERSION, SERVER_VERSION
+from etcd_tpu.utils.trace import Field, Trace
 
 __version__ = SERVER_VERSION
 
@@ -86,7 +87,14 @@ class V3Api:
         self._watch_member = 0
 
     # -- kv ------------------------------------------------------------------
+    # every KV handler opens the request's Trace HERE — the earliest
+    # host-side point, so the recorded span covers json-decode-to-respond
+    # (the reference starts its traceutil.Trace at the grpc handler,
+    # v3_server.go:95-133); kvserver threads it through propose ->
+    # wait-applied -> respond and retires it into ec.req_spans for
+    # blackbox.to_chrome_trace
     def kv_range(self, q: dict) -> dict:
+        trace = Trace("range", Field("rpc", "kv_range"))
         kvs = self.ec.range(
             _unb64(q["key"]),
             _unb64(q.get("range_end")),
@@ -95,6 +103,7 @@ class V3Api:
             serializable=bool(q.get("serializable")),
             count_only=bool(q.get("count_only")),
             token=q.get("_token"),
+            trace=trace,
         )
         return {
             "header": _header_json(kvs["header"]),
@@ -106,11 +115,13 @@ class V3Api:
         return _header_json(self.ec._header(self.ec.ensure_leader()))
 
     def kv_put(self, q: dict) -> dict:
+        trace = Trace("put", Field("rpc", "kv_put"))
         res = self.ec.put(
             _unb64(q["key"]), _unb64(q.get("value")) or b"",
             lease=_int(q.get("lease")),
             prev_kv=bool(q.get("prev_kv")),
             token=q.get("_token"),
+            trace=trace,
         )
         out = {"header": self._header()}
         if res.get("prev_kv"):
@@ -118,10 +129,12 @@ class V3Api:
         return out
 
     def kv_deleterange(self, q: dict) -> dict:
+        trace = Trace("delete_range", Field("rpc", "kv_deleterange"))
         res = self.ec.delete_range(
             _unb64(q["key"]), _unb64(q.get("range_end")),
             prev_kv=bool(q.get("prev_kv")),
             token=q.get("_token"),
+            trace=trace,
         )
         out = {
             "header": self._header(),
@@ -160,11 +173,13 @@ class V3Api:
         return Compare(key, field, result, val)
 
     def kv_txn(self, q: dict) -> dict:
+        trace = Trace("txn", Field("rpc", "kv_txn"))
         res = self.ec.txn(
             [self._parse_cmp(c) for c in q.get("compare", [])],
             [self._parse_op(o) for o in q.get("success", [])],
             [self._parse_op(o) for o in q.get("failure", [])],
             token=q.get("_token"),
+            trace=trace,
         )
         responses = []
         for entry in res["responses"]:
@@ -206,6 +221,7 @@ class V3Api:
             if bad:
                 raise ServerError(f"unknown watch filters {bad}")
             filters = tuple(known[f] for f in c.get("filters", []))
+            trace = Trace("watch_create", Field("rpc", "watch"))
             w = self.ec.watch(
                 self._watch_member,
                 _unb64(c["key"]), _unb64(c.get("range_end")),
@@ -215,6 +231,9 @@ class V3Api:
                 progress_notify=bool(c.get("progress_notify")),
                 filters=filters,
             )
+            trace.step("watcher registered", Field("watch_id", w.id))
+            trace.log_if_long(self.ec.TRACE_THRESHOLD_S)
+            self.ec._record_span(trace)
             return {"created": True, "watch_id": str(w.id)}
         if "poll_request" in q:
             return self._watch_poll(q["poll_request"])
@@ -769,8 +788,14 @@ class V3Server:
                                 # families
                                 api.ec.cl.reset_telemetry()
                         td = getattr(api.ec, "contention", None)
+                        slow = {
+                            "slow_apply_total": getattr(
+                                api.ec, "slow_apply_total", 0),
+                            "slow_read_indexes_total": getattr(
+                                api.ec, "slow_read_index_total", 0),
+                        }
                     blob = prometheus_render(server_metric_families(
-                        s, trep, contention=td)).encode()
+                        s, trep, contention=td, slow=slow)).encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      PROMETHEUS_CONTENT_TYPE)

@@ -55,3 +55,14 @@ def env_bool(prog: str, name: str, default: str) -> bool:
     if raw not in ("0", "1"):
         knob_error(prog, f"{name}={raw!r} is not 0 or 1")
     return raw == "1"
+
+
+def env_str(prog: str, name: str, default: str,
+            choices: tuple[str, ...] | None = None) -> str:
+    """String knob; with ``choices`` a value outside the set exits 2 (a
+    typo'd CHAOS_DURABILITY=stabel must not silently run a different
+    durability model)."""
+    raw = os.environ.get(name, default)
+    if choices is not None and raw not in choices:
+        knob_error(prog, f"{name}={raw!r} is not one of {'/'.join(choices)}")
+    return raw

@@ -85,6 +85,26 @@ class Trace:
             prev = t
         return "\n".join(lines)
 
+    def to_span(self) -> dict:
+        """A plain-dict span for exporters (blackbox.to_chrome_trace):
+        relative step offsets in seconds, field values coerced to JSON
+        primitives so the span survives json.dumps unmodified."""
+        def prim(v):
+            return v if isinstance(v, (bool, int, float, str,
+                                       type(None))) else repr(v)
+
+        return {
+            "op": self.operation,
+            "start": self.start_time,
+            "dur": self.duration(),
+            "fields": {f.key: prim(f.value) for f in self.fields},
+            "steps": [
+                {"ts": t - self.start_time, "msg": msg,
+                 "fields": {f.key: prim(f.value) for f in fields}}
+                for t, msg, fields in self.steps
+            ],
+        }
+
     def log_if_long(self, threshold_s: float = 0.1) -> bool:
         """Log the timeline if total duration exceeded the threshold (the
         warningApplyDuration dump rule, v3_server.go:602-610). Returns

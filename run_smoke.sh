@@ -26,7 +26,11 @@
 # (tests/test_telemetry.py) runs here too: round-program bit-identity
 # with the telemetry plane fused in (dense + diet forms), a host-replay
 # histogram cross-check, and the small-C chaos flight-recorder run
-# asserting the per-epoch timeline is present and monotone.
+# asserting the per-epoch timeline is present and monotone. The fast
+# forensics tier (tests/test_telemetry_blackbox.py, tests/test_trace.py) rides
+# along: black-box ring bit-identity over the same round programs, the
+# numpy word-replay cross-check, the persist-nothing post-mortem at
+# C=16, and the host Trace unit tests — all small-C, no slow marks.
 cd "$(dirname "$0")"
 exec python -m pytest -q -m 'not slow' \
   tests/test_datadriven_quorum.py \
@@ -49,4 +53,6 @@ exec python -m pytest -q -m 'not slow' \
   tests/test_recovery_member.py \
   tests/test_device_mvcc.py \
   tests/test_telemetry.py \
+  tests/test_trace.py \
+  tests/test_telemetry_blackbox.py \
   "$@"

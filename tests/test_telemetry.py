@@ -320,9 +320,9 @@ def test_chaos_epoch_bit_identity_with_telemetry():
         with_telemetry=True))
     tele = init_telemetry(SPEC, state)
     out_a = plain(state, inbox, None, crash, key, prop_len, prop_data,
-                  zero_violations(), None, *ops)
+                  zero_violations(), None, None, *ops)
     out_b = telem(state, inbox, None, crash, key, prop_len, prop_data,
-                  zero_violations(), tele, *ops)
+                  zero_violations(), tele, None, *ops)
     _assert_states_equal(out_a[0], out_b[0], "chaos epoch", rounds)
     assert np.array_equal(np.asarray(out_a[1].type),
                           np.asarray(out_b[1].type))

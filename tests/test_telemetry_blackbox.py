@@ -1,0 +1,551 @@
+"""Black-box forensics plane (ISSUE 15): ring bit-identity, host
+word-replay cross-checks, on-violation extraction, the Chrome trace
+export and the forensics knob contract.
+
+The load-bearing contract mirrors the telemetry plane's: the event ring
+RIDES BESIDE the fleet state and never feeds back, so a ring-on round
+must reproduce the ring-off round BIT-FOR-BIT in state and wire — over
+the rich full-program scenario and under the PR-8 diet forms
+(packed_state, sparse_outbox) and the crash-chaos epoch program. The
+ring's bit-packed WORDS are then cross-checked against an independent
+numpy replay of the recorded trajectory, and the extraction path is
+proven end-to-end: a persist-nothing chaos run must pinpoint the
+lost-commit round while only the offending groups' rings cross PCIe.
+"""
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from etcd_tpu.models.blackbox import (
+    HOST_PID,
+    MSG_CLASSES,
+    ROLE_NAMES,
+    VIOLATION_BIT_NAMES,
+    decode_word,
+    first_k_offenders,
+    gather_forensics,
+    init_blackbox,
+    ring_capture,
+    to_chrome_trace,
+    violation_names,
+)
+from etcd_tpu.models.engine import build_round, empty_inbox, init_fleet
+from etcd_tpu.models.metrics import build_metered_round, zero_metrics
+from etcd_tpu.models.state import NodeState, pack_fleet, unpack_fleet
+from etcd_tpu.types import (
+    ENTRY_NORMAL,
+    MSG_APP,
+    MSG_APP_RESP,
+    MSG_HEARTBEAT,
+    MSG_HEARTBEAT_RESP,
+    MSG_HUP,
+    MSG_PRE_VOTE,
+    MSG_PRE_VOTE_RESP,
+    MSG_PROP,
+    MSG_SNAP,
+    MSG_SNAP_STATUS,
+    MSG_TIMEOUT_NOW,
+    MSG_TRANSFER_LEADER,
+    MSG_VOTE,
+    MSG_VOTE_RESP,
+    ROLE_LEADER,
+    Spec,
+)
+from etcd_tpu.utils.config import RaftConfig
+from etcd_tpu.utils.trace import Field, Trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the test_packed_state / test_telemetry rich-scenario geometry:
+# elections, a partition window long enough for snapshot fallback, a
+# read-index wave, ticks
+SPEC = Spec(M=3, L=16, E=1, K=2, W=2, R=2, A=2)
+CFG = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=2,
+                 inbox_bound=4)
+C = 16
+ROUNDS = 48
+# window >= ROUNDS: the whole trajectory stays resident (partial fill,
+# no slot reuse), so the replay can address slot r directly
+WINDOW = 64
+
+
+def _inputs(r: int):
+    M, E = SPEC.M, SPEC.E
+    hup = np.zeros((M, C), bool)
+    if r == 0:
+        for c in range(C):
+            hup[c % M, c] = True
+    plen = np.zeros((M, C), np.int32)
+    pdata = np.zeros((M, E, C), np.int32)
+    ptype = np.zeros((M, E, C), np.int32)
+    if 2 <= r < ROUNDS - 10:
+        plen[0, :] = 1
+        pdata[0, 0, :] = r * 64 + np.arange(C)
+        ptype[0, 0, :] = ENTRY_NORMAL
+    ri = np.zeros((M, C), np.int32)
+    if r == 24:
+        ri[0, :] = 7
+    keep = np.ones((M, M, C), bool)
+    if 8 <= r < 18:
+        keep[1, :, 4:8] = False
+        keep[:, 1, 4:8] = False
+    tick = np.full((M, C), r % 3 == 0 or r >= ROUNDS - 8, bool)
+    return plen, pdata, ptype, ri, hup, tick, keep
+
+
+def _assert_states_equal(a: NodeState, b: NodeState, label: str, r: int):
+    for name in NodeState.__dataclass_fields__:
+        assert np.array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        ), f"{label}: state.{name} diverged at round {r}"
+
+
+@pytest.fixture(scope="module")
+def plain_run():
+    """Reference trajectory, recording the consumed wire of every round
+    (inbox r-1) alongside the emitted wire — the replay needs both."""
+    round_fn = jax.jit(build_round(CFG, SPEC))
+    init = init_fleet(SPEC, C, seed=0, election_tick=CFG.election_tick)
+    init_inbox = empty_inbox(SPEC, C)
+    state, inbox = init, init_inbox
+    states, inboxes = [], []
+    for r in range(ROUNDS):
+        state, inbox = round_fn(state, inbox, *_inputs(r))
+        states.append(state)
+        inboxes.append(inbox)
+    assert int((np.asarray(state.role) == ROLE_LEADER).sum()) == C
+    return init, init_inbox, states, inboxes
+
+
+def _ring_run(cfg, window=WINDOW):
+    step = jax.jit(build_metered_round(cfg, SPEC, with_blackbox=True))
+    state = init_fleet(SPEC, C, seed=0, election_tick=cfg.election_tick)
+    base = state
+    if cfg.packed_state:
+        state = pack_fleet(SPEC, state)
+    inbox = empty_inbox(
+        SPEC, C, compact_bound=cfg.inbox_bound if cfg.compact_wire else 0)
+    metrics = zero_metrics()
+    bb = init_blackbox(SPEC, base, window=window)
+    states, inboxes = [], []
+    for r in range(ROUNDS):
+        state, inbox, metrics, bb = step(state, inbox, *_inputs(r),
+                                         metrics, blackbox=bb)
+        states.append(unpack_fleet(SPEC, state) if cfg.packed_state
+                      else state)
+        inboxes.append(inbox)
+    return states, inboxes, bb
+
+
+@pytest.fixture(scope="module")
+def ring_run_dense():
+    return _ring_run(CFG)
+
+
+def test_ring_round_state_bit_identity(plain_run, ring_run_dense):
+    """The tentpole's proof: the fused ring reduction leaves the state
+    AND wire trajectories bit-identical over the rich scenario."""
+    _, _, ref_states, ref_inboxes = plain_run
+    states, inboxes, bb = ring_run_dense
+    for r, (a, b) in enumerate(zip(ref_states, states)):
+        _assert_states_equal(a, b, "ring", r)
+    for r, (a, b) in enumerate(zip(ref_inboxes, inboxes)):
+        assert np.array_equal(np.asarray(a.type), np.asarray(b.type)), \
+            f"wire diverged at round {r}"
+    assert int(np.asarray(bb.round)) == ROUNDS
+
+
+def test_ring_packed_state_bit_identity(plain_run, ring_run_dense):
+    """The ring composes with the PR-8 diet: packed carry in,
+    bit-identical unpacked trajectory out, and the SAME ring words as
+    the dense run (the words read the unpacked view)."""
+    _, _, ref_states, _ = plain_run
+    pcfg = dataclasses.replace(CFG, packed_state=True)
+    states, _, bb_p = _ring_run(pcfg)
+    for r, (a, b) in enumerate(zip(ref_states, states)):
+        _assert_states_equal(a, b, "packed+ring", r)
+    _, _, bb_d = ring_run_dense
+    assert np.array_equal(np.asarray(bb_p.ring), np.asarray(bb_d.ring))
+
+
+def test_ring_sparse_outbox_bit_identity():
+    """Steady-traffic bit-identity under the diet's sparse_outbox form
+    (same contract split as tests/test_sparse_outbox.py)."""
+    spec = Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+    full = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=4,
+                      inbox_bound=4, coalesce_commit_refresh=True)
+    sparse = dataclasses.replace(
+        full, local_steps=("prop",),
+        message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP),
+        deferred_emit=True, sparse_outbox=True)
+    Cs = 4
+    M, E = spec.M, spec.E
+    boot = jax.jit(build_round(full, spec))
+    state = init_fleet(spec, Cs, seed=0, election_tick=full.election_tick)
+    inbox = empty_inbox(spec, Cs)
+    z2 = np.zeros((M, Cs), np.int32)
+    zp = np.zeros((M, E, Cs), np.int32)
+    no = np.zeros((M, Cs), bool)
+    keep = np.ones((M, M, Cs), bool)
+    hup = no.copy()
+    hup[0, :] = True
+    state, inbox = boot(state, inbox, z2, zp, zp, z2, hup, no, keep)
+    for _ in range(12):
+        state, inbox = boot(state, inbox, z2, zp, zp, z2, no, no, keep)
+    assert (np.asarray(state.role)[0] == ROLE_LEADER).all()
+
+    plen = z2.copy()
+    plen[0, :] = 1
+    pdata = zp.copy()
+    pdata[0, 0, :] = 9
+    args = (plen, pdata, zp, z2, no, no, keep)
+    bare = jax.jit(build_round(sparse, spec))
+    met = jax.jit(build_metered_round(sparse, spec, with_blackbox=True))
+    s_a, i_a = state, inbox
+    s_b, i_b = state, inbox
+    metrics, bb = zero_metrics(), init_blackbox(spec, state, window=16)
+    for r in range(12):
+        s_a, i_a = bare(s_a, i_a, *args)
+        s_b, i_b, metrics, bb = met(s_b, i_b, *args, metrics, blackbox=bb)
+        _assert_states_equal(s_a, s_b, "sparse_outbox+ring", r)
+        assert np.array_equal(np.asarray(i_a.type), np.asarray(i_b.type))
+    # the leader's words show steady append traffic going out
+    ring = np.asarray(bb.ring)
+    last = decode_word(int(ring[(12 - 1) % 16, 0, 0]))
+    assert "append" in last["sent"] and last["commit_delta"] > 0
+
+
+# ---------------------------------------------------------------------------
+# host replay cross-check: an independent numpy decode of the recorded
+# trajectory, compared word by word against the device ring
+# ---------------------------------------------------------------------------
+
+_APPEND = {MSG_APP, MSG_APP_RESP, MSG_SNAP, MSG_SNAP_STATUS}
+_ELECT = {MSG_VOTE, MSG_VOTE_RESP, MSG_PRE_VOTE, MSG_PRE_VOTE_RESP,
+          MSG_TIMEOUT_NOW, MSG_TRANSFER_LEADER, MSG_HUP}
+_HB = {MSG_HEARTBEAT, MSG_HEARTBEAT_RESP}
+
+
+def _np_class(t: int) -> str:
+    if t in _APPEND:
+        return "append"
+    if t in _ELECT:
+        return "election"
+    if t in _HB:
+        return "heartbeat"
+    return "other"
+
+
+def _np_activity(M: int, msg, side: str):
+    """(counts [M, C], {(m, c): sorted class names}) from a flat wire
+    pytree — senders by the frm field, receivers by slot % M."""
+    t = np.asarray(msg.type)
+    frm = np.asarray(msg.frm)
+    live = t != 0
+    S = t.shape[1]
+    Cn = t.shape[-1]
+    counts = np.zeros((M, Cn), np.int64)
+    classes = {}
+    for m in range(M):
+        if side == "send":
+            mask = live & (frm == m)
+        else:
+            mask = live & ((np.arange(S) % M == m)[None, :, None])
+        counts[m] = mask.sum(axis=(0, 1))
+        for c in range(Cn):
+            names = {_np_class(int(tt)) for tt in t[:, :, c][mask[:, :, c]]}
+            classes[(m, c)] = [k for k in MSG_CLASSES if k in names]
+    return counts, classes
+
+
+def _replay_round(spec, pre, post, consumed, emitted):
+    """Expected decode_word() dict for every (member, group) of one
+    round — computed with plain numpy, independent of the bit packing."""
+    role0 = np.asarray(pre.role)
+    role = np.asarray(post.role)
+    term_d = np.clip(np.asarray(post.term) - np.asarray(pre.term), 0, 7)
+    com_d = np.clip(np.asarray(post.commit) - np.asarray(pre.commit), 0, 7)
+    app = np.asarray(post.applied) - np.asarray(pre.applied)
+    cc = np.zeros(role.shape, bool)
+    for f in ("voters", "voters_out", "learners", "learners_next"):
+        cc |= (np.asarray(getattr(pre, f))
+               != np.asarray(getattr(post, f))).any(axis=1)
+    sent_n, sent_cls = _np_activity(spec.M, emitted, "send")
+    recv_n, recv_cls = _np_activity(spec.M, consumed, "recv")
+    out = {}
+    for m in range(spec.M):
+        for c in range(role.shape[-1]):
+            out[(m, c)] = {
+                "role": ROLE_NAMES[int(role[m, c])],
+                "role_change": bool(role[m, c] != role0[m, c]),
+                "term_delta": int(term_d[m, c]),
+                "commit_delta": int(com_d[m, c]),
+                "applied_delta": int(np.clip(app[m, c], 0, 7)),
+                "snapshot_install": bool(app[m, c] > spec.A),
+                "conf_change": bool(cc[m, c]),
+                "crashed": False,
+                "restarted": False,
+                "down": False,
+                "sent": sent_cls[(m, c)],
+                "recv": recv_cls[(m, c)],
+                "sent_count": min(int(sent_n[m, c]), 7),
+                "recv_count": min(int(recv_n[m, c]), 7),
+            }
+    return out
+
+
+def test_ring_words_match_host_replay(plain_run, ring_run_dense):
+    """Every word of the partially-filled ring decodes to exactly the
+    fields an independent numpy replay derives from the recorded
+    trajectory — roles, transitions, frontier deltas, the snapshot
+    install the partition forces, and per-class wire activity."""
+    init, init_inbox, states, inboxes = plain_run
+    _, _, bb = ring_run_dense
+    ring = np.asarray(bb.ring)
+    assert ring.shape == (WINDOW, SPEC.M, C)
+    # partial fill: rounds past the trajectory never got written
+    assert not ring[ROUNDS:].any()
+    pre_states = [init] + states[:-1]
+    pre_inboxes = [init_inbox] + inboxes[:-1]
+    snap_seen = False
+    for r in range(ROUNDS):
+        exp = _replay_round(SPEC, pre_states[r], states[r],
+                            pre_inboxes[r], inboxes[r])
+        for m in range(SPEC.M):
+            for c in range(C):
+                got = decode_word(int(ring[r, m, c]))
+                assert got == exp[(m, c)], (r, m, c, got, exp[(m, c)])
+                snap_seen |= got["snapshot_install"]
+    # rich enough to prove anything: the partition forced a laggard
+    # through snapshot fallback and the ring recorded it
+    assert snap_seen
+
+
+# ---------------------------------------------------------------------------
+# chaos epoch composition + on-violation extraction
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_epoch_bit_identity_with_blackbox():
+    """The crash-chaos epoch program with the BlackBox carry produces
+    the exact same state/wire/violations/key as the program without it
+    (the per-group checker masks derive from the same intermediates the
+    counters sum)."""
+    from etcd_tpu.harness.chaos import (
+        build_chaos_epoch,
+        empty_blackbox,
+        empty_crash_state,
+        zero_violations,
+    )
+
+    Cs, rounds = 8, 8
+    M = SPEC.M
+    state = init_fleet(SPEC, Cs, seed=2, election_tick=CFG.election_tick)
+    inbox = empty_inbox(SPEC, Cs)
+    crash = empty_crash_state(state)
+    key = jax.random.PRNGKey(7)
+    prop_len = jnp.zeros((M, Cs), jnp.int32).at[0].set(1)
+    prop_data = jnp.zeros((M, SPEC.E, Cs), jnp.int32).at[0, 0].set(7)
+    pal = jnp.zeros((1,), jnp.int32)
+    ops = (jnp.float32(0.05), jnp.float32(0.0), jnp.float32(0.1),
+           jnp.float32(0.08), jnp.int32(2), jnp.bool_(True),
+           jnp.bool_(True), jnp.float32(0.0), pal, jnp.float32(1.0),
+           jnp.float32(1.0))
+    plain = jax.jit(build_chaos_epoch(
+        CFG, SPEC, rounds, with_delay=False, with_crash=True))
+    boxed = jax.jit(build_chaos_epoch(
+        CFG, SPEC, rounds, with_delay=False, with_crash=True,
+        with_blackbox=True))
+    bb = empty_blackbox(SPEC, state, window=16)
+    out_a = plain(state, inbox, None, crash, key, prop_len, prop_data,
+                  zero_violations(), None, None, *ops)
+    out_b = boxed(state, inbox, None, crash, key, prop_len, prop_data,
+                  zero_violations(), None, bb, *ops)
+    _assert_states_equal(out_a[0], out_b[0], "chaos epoch", rounds)
+    assert np.array_equal(np.asarray(out_a[1].type),
+                          np.asarray(out_b[1].type))
+    assert np.array_equal(np.asarray(out_a[4]), np.asarray(out_b[4]))
+    for leaf_a, leaf_b in zip(jax.tree.leaves(out_a[5]),
+                              jax.tree.leaves(out_b[5])):
+        assert np.array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
+    assert int(np.asarray(out_a[8])) == int(np.asarray(out_b[8]))
+    bb_out = out_b[7]
+    assert bb_out is not None
+    assert int(np.asarray(bb_out.ring.round)) == rounds
+
+
+def test_violation_bit_order_pinned_to_chaos_keys():
+    from etcd_tpu.harness import chaos
+
+    assert tuple(chaos.VIOLATION_KEYS) == VIOLATION_BIT_NAMES
+
+
+def test_first_k_offenders_device_reduction():
+    mask = jnp.zeros((12,), bool).at[7].set(True).at[2].set(True)
+    assert list(np.asarray(first_k_offenders(mask, 4))) == [2, 7, 12, 12]
+    assert list(np.asarray(first_k_offenders(mask, 1))) == [2]
+    none = jnp.zeros((12,), bool)
+    assert list(np.asarray(first_k_offenders(none, 3))) == [12, 12, 12]
+
+
+def test_gather_forensics_narrow_transfer():
+    """Only the first-K offending groups' ring lanes cross PCIe: the
+    gathered rings are [W, M, k], never fleet-width."""
+    state = init_fleet(SPEC, C, seed=0)
+    ring = init_blackbox(SPEC, state, window=8)
+    viol_groups = (jnp.zeros((C,), jnp.int32)
+                   .at[5].set(1 << 3)    # lost_commit
+                   .at[11].set(1 << 0))  # multi_leader
+    viol_round = (jnp.full((C,), -1, jnp.int32).at[5].set(9)
+                  .at[11].set(12))
+    g = gather_forensics(ring, viol_groups, viol_round, k=4)
+    assert g["rings"].shape == (8, SPEC.M, 4)
+    assert list(g["ids"]) == [5, 11, C, C]
+    assert int(g["total"]) == 2
+    assert violation_names(int(g["bits"][0])) == ["lost_commit"]
+    assert violation_names(int(g["bits"][1])) == ["multi_leader"]
+    assert int(g["viol_round"][0]) == 9
+
+
+def test_persist_nothing_forensics_pinpoints_lost_commit():
+    """The extraction acceptance end-to-end: a crash-chaos run under the
+    deliberately-broken persist-nothing durability model violates
+    lost-commit, and the forensics section pinpoints the offending
+    round with the crash/down events leading into it."""
+    from etcd_tpu.harness.chaos import run_chaos
+    from etcd_tpu.utils.config import CrashConfig
+
+    spec = Spec(M=5, L=32, E=2, K=4, W=2, R=2, A=4)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True)
+    rep = run_chaos(
+        spec, cfg, C=16, rounds=25, epoch_len=25, heal_len=25, seed=3,
+        drop_p=0.0, delay_p=0.08, partition_p=0.0, crash_p=0.12,
+        crash=CrashConfig(down_rounds=2, durability="none"),
+        blackbox=True, blackbox_k=4,
+    )
+    assert rep["lost_commit"] > 0
+    f = rep["forensics"]
+    assert f["window"] >= 2
+    assert f["groups_violating"] >= 1
+    assert f["captured"], "violating groups but nothing captured"
+    cap = f["captured"][0]
+    assert "lost_commit" in cap["violations"]
+    vr = cap["first_violation_round"]
+    assert vr >= 0
+    # the frozen ring's preserved window ENDS at the violation round
+    assert cap["timeline"][-1]["round"] == vr
+    # and the rounds leading in show the crash machinery at work
+    events = {e for row in cap["timeline"] for mem in row["members"]
+              for e in mem["events"]}
+    assert events & {"crash", "down"}, events
+    # the whole report (forensics included) is strict JSON
+    json.loads(json.dumps(rep))
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export (Perfetto / chrome://tracing)
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_schema(ring_run_dense):
+    """Device tracks from a live ring capture + host spans from traced
+    requests land in one loadable Chrome trace: every event carries
+    ph/pid/tid, device tracks use group/member ids, host spans sit on
+    their own synthetic process with one child slice per trace step."""
+    _, _, bb = ring_run_dense
+    caps = ring_capture(bb, [0, 3])
+    t1 = Trace("put", Field("rpc", "kv_put"))
+    t1.step("proposed through raft")
+    t1.step("applied; result ready")
+    t2 = Trace("range", Field("serializable", False))
+    t2.step("read-index settled")
+    doc = to_chrome_trace(captures=caps, spans=[t1.to_span(), t2.to_span()])
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    evs = doc["traceEvents"]
+    assert all({"ph", "name", "pid", "tid"} <= set(e) for e in evs)
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+    device = [e for e in xs if e["cat"] == "device"]
+    host = [e for e in xs if e["cat"] == "host"]
+    assert {e["pid"] for e in device} == {0, 3}
+    assert {e["pid"] for e in host} == {HOST_PID}
+    # the live window covers rounds [round - W + 1, round - 1] clipped
+    # at 0: W=64 >= 48 rounds -> the full 48-round history, per member
+    assert len(device) == 2 * ROUNDS * SPEC.M
+    # host: one span slice per request + one child slice per step
+    assert len(host) == 2 + 3
+    # process metadata for both groups and the host track
+    procs = [e for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert {p["pid"] for p in procs} == {0, 3, HOST_PID}
+    json.loads(json.dumps(doc))
+
+
+# ---------------------------------------------------------------------------
+# init hygiene + knob contract
+# ---------------------------------------------------------------------------
+
+
+def test_init_blackbox_leaves_share_no_buffers():
+    """Every EventRing leaf owns its buffer: the chaos epoch programs
+    donate the whole carry on accelerators, and XLA rejects one buffer
+    appearing at two donated positions in a single Execute (the
+    empty_crash_state alias hazard class)."""
+    state = init_fleet(SPEC, 4, seed=0)
+    bb = init_blackbox(SPEC, state)
+    ptrs = [leaf.unsafe_buffer_pointer() for leaf in jax.tree.leaves(bb)]
+    assert len(ptrs) == len(set(ptrs)), "aliased ring leaves"
+    state_ptrs = {leaf.unsafe_buffer_pointer()
+                  for leaf in jax.tree.leaves(state)}
+    assert not state_ptrs & set(ptrs), "ring leaf aliases state"
+
+
+def test_init_blackbox_rejects_bad_window():
+    state = init_fleet(SPEC, 2, seed=0)
+    with pytest.raises(ValueError, match="window"):
+        init_blackbox(SPEC, state, window=1)
+    with pytest.raises(ValueError, match="window"):
+        init_blackbox(SPEC, state, window=257)
+
+
+@pytest.mark.parametrize("script,env_extra,needle", [
+    ("chaos_run.py", {"TELEM_EVERY": "0"}, "TELEM_EVERY"),
+    ("chaos_run.py", {"CHAOS_BLACKBOX": "2"}, "CHAOS_BLACKBOX"),
+    ("chaos_run.py", {"CHAOS_BLACKBOX_WINDOW": "1"},
+     "CHAOS_BLACKBOX_WINDOW"),
+    ("bench.py", {"BENCH_BLACKBOX": "x"}, "BENCH_BLACKBOX"),
+])
+def test_forensics_knob_validation_exits_2(script, env_extra, needle):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", **env_extra}
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, script)],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert out.returncode == 2, (out.returncode, out.stdout, out.stderr)
+    assert needle in out.stderr
+    assert not out.stdout.strip()
+
+
+def test_drivers_read_env_through_knob_helpers_only():
+    """Knob hygiene: the scale drivers route every env knob through
+    utils/knobs (one validation idiom, one exit-2 contract). Raw
+    os.environ VALUE reads are banned outside the allowlist; presence
+    checks (`"X" in os.environ`) and child-env construction
+    (`dict(os.environ, ...)`) are fine and don't match."""
+    allow = {"JAX_PLATFORMS"}
+    pat = re.compile(r'os\.environ(?:\.get\(|\[)\s*"(\w+)"')
+    for script in ("bench.py", "chaos_run.py"):
+        with open(os.path.join(REPO, script)) as fh:
+            src = fh.read()
+        bad = sorted({m.group(1) for m in pat.finditer(src)} - allow)
+        assert not bad, (
+            f"{script} reads {bad} straight off os.environ; "
+            "route new knobs through etcd_tpu.utils.knobs")

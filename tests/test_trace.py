@@ -1,0 +1,103 @@
+"""Unit tests for utils/trace.py — the host half of the request-tracing
+tentpole (ISSUE 15). The reference's pkg/traceutil has its own table
+tests (trace_test.go); these cover the same surface: step ordering,
+TODO inertness, AddField set-or-replace, and the threshold dump rule.
+"""
+import json
+import time
+
+from etcd_tpu.utils.logging import DiscardLogger, get_logger, set_logger
+from etcd_tpu.utils.trace import Field, Trace
+
+
+class _CaptureLogger(DiscardLogger):
+    def __init__(self):
+        self.lines = []
+
+    def warning(self, fmt, *args):
+        self.lines.append(fmt % args if args else fmt)
+
+
+def test_step_ordering_and_format():
+    t = Trace("put", Field("member", 0))
+    t.step("proposed through raft", Field("word", 7))
+    t.step("applied; result ready")
+    t.step("backends fsynced")
+    msgs = [m for _, m, _ in t.steps]
+    assert msgs == ["proposed through raft", "applied; result ready",
+                    "backends fsynced"]
+    # timestamps are monotone non-decreasing (perf_counter)
+    stamps = [ts for ts, _, _ in t.steps]
+    assert stamps == sorted(stamps)
+    out = t.format()
+    assert "put" in out.splitlines()[0]
+    assert "member:0" in out
+    for m in msgs:
+        assert m in out
+    # per-step fields render next to their step line
+    assert "word:7" in out
+
+
+def test_todo_is_inert():
+    t = Trace.todo()
+    t.step("never recorded")
+    t.add_field(Field("k", "v"))
+    assert t.is_empty
+    assert t.steps == []
+    # an inert trace never dumps, whatever the threshold
+    cap = _CaptureLogger()
+    old = get_logger()
+    set_logger(cap)
+    try:
+        assert t.log_if_long(0.0) is False
+    finally:
+        set_logger(old)
+    assert cap.lines == []
+
+
+def test_add_field_set_or_replace():
+    t = Trace("range")
+    t.add_field(Field("serializable", False))
+    t.add_field(Field("count", 3))
+    # replace by key, preserving position; new keys append
+    t.add_field(Field("serializable", True), Field("limit", 10))
+    assert [(f.key, f.value) for f in t.fields] == [
+        ("serializable", True), ("count", 3), ("limit", 10)]
+
+
+def test_threshold_dump_fires_only_past_cutoff():
+    cap = _CaptureLogger()
+    old = get_logger()
+    set_logger(cap)
+    try:
+        t = Trace("put")
+        t.step("fast path")
+        # far below any sane threshold: no dump
+        assert t.log_if_long(60.0) is False
+        assert cap.lines == []
+        # past the cutoff: dumps exactly once per call, returns True
+        time.sleep(0.01)
+        assert t.log_if_long(0.005) is True
+        assert len(cap.lines) == 1
+        assert "fast path" in cap.lines[0]
+    finally:
+        set_logger(old)
+
+
+def test_to_span_shape_and_json_safety():
+    t = Trace("txn", Field("rpc", "kv_txn"), Field("blob", b"\x00bytes"))
+    t.step("proposed through raft", Field("word", 1))
+    t.step("applied; result ready")
+    span = t.to_span()
+    assert span["op"] == "txn"
+    assert span["dur"] >= 0
+    # step offsets are relative to the span start and monotone
+    offs = [st["ts"] for st in span["steps"]]
+    assert offs == sorted(offs) and all(o >= 0 for o in offs)
+    assert [st["msg"] for st in span["steps"]] == [
+        "proposed through raft", "applied; result ready"]
+    assert span["steps"][0]["fields"] == {"word": 1}
+    # non-primitive field values are coerced so the span survives
+    # json.dumps (the Chrome trace exporter feeds these straight in)
+    assert isinstance(span["fields"]["blob"], str)
+    json.dumps(span)

@@ -30,6 +30,7 @@ def etcd(tmp_path_factory):
         data_dir=str(tmp_path_factory.mktemp("embed")),
         auto_tick=False,
         telemetry=True,  # /metrics histogram families ride the plane
+        blackbox=True,   # event ring behind the Chrome trace export
     )
     e = start_etcd(cfg)
     yield e
@@ -160,6 +161,75 @@ def test_metrics_prometheus_conformance(etcd):
     ]))
     assert {n: f["samples"] for n, f in fams2.items()} == \
         {n: f["samples"] for n, f in fams.items()}
+    # the slow-request counter families (ISSUE 15) ride the same scrape
+    for name in ("etcd_server_slow_apply_total",
+                 "etcd_server_slow_read_indexes_total"):
+        assert fams[name]["type"] == "counter"
+
+
+def test_prometheus_parse_rejects_counter_missing_type():
+    """A counter family whose samples precede any # TYPE declaration is
+    nonconformant — the parser must refuse it, not guess."""
+    from etcd_tpu.models.telemetry import prometheus_parse
+
+    with pytest.raises(ValueError, match="TYPE"):
+        prometheus_parse(
+            "# HELP etcd_server_slow_apply_total The total.\n"
+            "etcd_server_slow_apply_total 3\n")
+
+
+def test_slow_request_counters_and_chrome_trace(etcd):
+    """The tracing tentpole end-to-end over real HTTP: force the slow
+    thresholds to zero, drive a put and a linearizable range, and the
+    new counter families increment on re-scrape; the recorded request
+    spans plus the live device ring export to one loadable Chrome
+    trace with both host and device tracks."""
+    from etcd_tpu.models.blackbox import (
+        HOST_PID,
+        ring_capture,
+        to_chrome_trace,
+    )
+    from etcd_tpu.models.telemetry import prometheus_parse
+
+    def scrape():
+        with urllib.request.urlopen(etcd.client_url + "/metrics") as r:
+            return prometheus_parse(r.read().decode())
+
+    def counter(fams, name):
+        return fams[name]["samples"][(name, ())]
+
+    srv = etcd.server
+    before = scrape()
+    # instance-attribute overrides; the class defaults stay intact for
+    # the other module tests
+    srv.SLOW_APPLY_THRESHOLD_S = 0.0
+    srv.SLOW_READ_INDEX_THRESHOLD_S = 0.0
+    try:
+        call(etcd, "/v3/kv/put", {"key": b64("slow/k"), "value": b64("v")})
+        res = call(etcd, "/v3/kv/range", {"key": b64("slow/k")})
+        assert res["count"] == "1"
+    finally:
+        del srv.SLOW_APPLY_THRESHOLD_S
+        del srv.SLOW_READ_INDEX_THRESHOLD_S
+    after = scrape()
+    assert counter(after, "etcd_server_slow_apply_total") > \
+        counter(before, "etcd_server_slow_apply_total")
+    assert counter(after, "etcd_server_slow_read_indexes_total") > \
+        counter(before, "etcd_server_slow_read_indexes_total")
+    # the traced put/range left spans with steps behind
+    spans = list(srv.req_spans)
+    ops = {s["op"] for s in spans}
+    assert {"put", "range"} <= ops
+    put_span = next(s for s in spans if s["op"] == "put")
+    assert any("raft" in st["msg"] for st in put_span["steps"])
+    # correlated export: device tracks from the serving fleet's ring,
+    # host tracks from the request spans, one Perfetto-loadable doc
+    assert srv.cl.bb is not None
+    caps = ring_capture(srv.cl.bb, [0])
+    doc = to_chrome_trace(captures=caps, spans=spans[-8:])
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert pids == {0, HOST_PID}
+    json.loads(json.dumps(doc))
 
 
 def test_http_election_and_lock(etcd):
