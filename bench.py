@@ -51,6 +51,16 @@ Headline-bench knobs (all validated the same way, exit 2 on bad values):
                 telemetry_overhead_pct (telemetered round vs bare round
                 at the same shape — PROFILE.md round 7)
   TELEM_BUCKETS power-of-two histogram buckets (default 8, 2..16)
+  BENCH_BLACKBOX black-box event ring in the observability pass
+                (default 1): a second metered program with the ring
+                reduction fused in reports the measured marginal
+                ring_overhead_pct next to the telemetry overhead
+  BENCH_PROFILE capture a jax profiler trace of the timed loop
+                (default 0)
+``--preflight`` runs the donation + one-trace auditors
+(etcd_tpu/analysis/audit.py) on the exact round program these knobs
+select, at a small probe C, and exits 1 on a contract violation before
+any device allocation.
 The report carries the measured footprint: bytes/group from the actual
 leaf dtypes/shapes of the timed carries, the dense-form baseline and
 their ratio, plus jax.live_arrays() and peak-RSS readings.
@@ -268,6 +278,16 @@ NORTH_STAR_GROUP_ROUNDS_PER_SEC = 1_000_000 * 10_000
 def main() -> None:
     import dataclasses as _dc
 
+    # --preflight is the only accepted argument (everything else is
+    # knob-driven); an unknown flag exits 2 like a bad knob would
+    preflight = "--preflight" in sys.argv[1:]
+    unknown = [a for a in sys.argv[1:] if a != "--preflight"]
+    if unknown:
+        print(f"bench: unknown argument(s): {' '.join(unknown)} "
+              f"(only --preflight; configure via BENCH_* knobs)",
+              file=sys.stderr)
+        raise SystemExit(2)
+
     # APPLY_* knob validation FIRST — a bad knob exits 2 before any
     # device work (tested in tests/test_device_mvcc.py)
     apply_knobs = _apply_knobs()
@@ -369,6 +389,67 @@ def main() -> None:
                      compact_wire=cwire and bound > 0)
     M, E = spec.M, spec.E
 
+    # trace-time specialization of the timed loop: the steady state has no
+    # ticks, no hups (leaders elected below; no ticks -> no timeout fires)
+    # and no read-index traffic, so those full-step passes are statically
+    # dead — and its WIRE TRAFFIC is exactly {MsgApp, MsgAppResp} plus the
+    # local MsgProp, so the other ~14 handler classes are dropped from the
+    # compiled step too (RaftConfig.local_steps / message_classes;
+    # bit-exact equivalence on live steady traffic proven by
+    # tests/test_local_steps.py). Election/settle and the metered
+    # observability pass keep the full program.
+    deferred = env_bool("bench", "BENCH_DEFERRED", "1")
+    if sparse and not deferred and "BENCH_SPARSE" in os.environ:
+        # explicitly requested but structurally impossible (the sparse
+        # scan carry IS a deferred-emission form) — exit 2, don't
+        # silently measure the dense-carry program
+        knob_error("bench", "BENCH_SPARSE=1 needs BENCH_DEFERRED=1 "
+                   "(the sparse scan carry is a deferred-emission form)")
+    steady_cfg = _dc.replace(
+        cfg,
+        local_steps=("prop",),
+        message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP),
+        # emission restructure (PROFILE.md round 4): scan-body handlers
+        # record PendingWire intents; one post-scan merge materializes
+        # them. Bit-exact on steady traffic (tests/test_deferred_emit.py).
+        # BENCH_DEFERRED=0 reverts to immediate emission for A/B runs.
+        deferred_emit=deferred,
+        # ...and its completion (round 6): the dense outbox leaves the
+        # scan carry entirely (tests/test_sparse_outbox.py)
+        sparse_outbox=sparse and deferred,
+        # the resident fleet state between timed rounds is the packed
+        # storage form; pack/unpack bracket the timed scan below
+        packed_state=packed,
+        # apply-scan specialization (PROFILE.md round 5): the steady
+        # program commits only normal entries, so the conf-change apply
+        # block (replayed on all Spec.A serial apply slots) drops at
+        # trace time (tests/test_apply_specialization.py).
+        # BENCH_CC=1 keeps it for A/B runs.
+        entry_classes=None if env_bool("bench", "BENCH_CC", "0")
+        else ("normal",),
+    )
+
+    if preflight:
+        # audit the EXACT program shapes this run will execute — the
+        # steady-state scan and (when observability is on) the metered
+        # round with the driver's donation set — at a small probe C,
+        # before the fleet is allocated at BENCH_C
+        from etcd_tpu.analysis.audit import run_preflight
+        from etcd_tpu.analysis.programs import bench_programs
+
+        finds = []
+        for inst in bench_programs(cfg, steady_cfg, spec, telem, bb_on,
+                                   buckets=telem_buckets):
+            finds += run_preflight(
+                inst, progress=lambda m: print(f"# {m}", file=sys.stderr))
+        if finds:
+            for f in finds:
+                print(f, file=sys.stderr)
+            print(f"# preflight: {len(finds)} contract violation(s)",
+                  file=sys.stderr)
+            raise SystemExit(1)
+        print("# preflight ok", file=sys.stderr)
+
     devs = jax.devices()
     mesh = make_fleet_mesh(len(devs)) if len(devs) > 1 else None
 
@@ -412,47 +493,6 @@ def main() -> None:
     # message load. Appends act as leader liveness, as in the reference.
     prop_len = z2.at[0].set(1)
     prop_data = zp.at[0, 0].set(7)
-    # trace-time specialization of the timed loop: the steady state has no
-    # ticks, no hups (leaders elected above; no ticks -> no timeout fires)
-    # and no read-index traffic, so those full-step passes are statically
-    # dead — and its WIRE TRAFFIC is exactly {MsgApp, MsgAppResp} plus the
-    # local MsgProp, so the other ~14 handler classes are dropped from the
-    # compiled step too (RaftConfig.local_steps / message_classes;
-    # bit-exact equivalence on live steady traffic proven by
-    # tests/test_local_steps.py). Election/settle and the metered
-    # observability pass keep the full program.
-    deferred = env_bool("bench", "BENCH_DEFERRED", "1")
-    if sparse and not deferred and "BENCH_SPARSE" in os.environ:
-        # explicitly requested but structurally impossible (the sparse
-        # scan carry IS a deferred-emission form) — exit 2, don't
-        # silently measure the dense-carry program
-        from etcd_tpu.utils.knobs import knob_error
-
-        knob_error("bench", "BENCH_SPARSE=1 needs BENCH_DEFERRED=1 "
-                   "(the sparse scan carry is a deferred-emission form)")
-    steady_cfg = _dc.replace(
-        cfg,
-        local_steps=("prop",),
-        message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP),
-        # emission restructure (PROFILE.md round 4): scan-body handlers
-        # record PendingWire intents; one post-scan merge materializes
-        # them. Bit-exact on steady traffic (tests/test_deferred_emit.py).
-        # BENCH_DEFERRED=0 reverts to immediate emission for A/B runs.
-        deferred_emit=deferred,
-        # ...and its completion (round 6): the dense outbox leaves the
-        # scan carry entirely (tests/test_sparse_outbox.py)
-        sparse_outbox=sparse and deferred,
-        # the resident fleet state between timed rounds is the packed
-        # storage form; pack/unpack bracket the timed scan below
-        packed_state=packed,
-        # apply-scan specialization (PROFILE.md round 5): the steady
-        # program commits only normal entries, so the conf-change apply
-        # block (replayed on all Spec.A serial apply slots) drops at
-        # trace time (tests/test_apply_specialization.py).
-        # BENCH_CC=1 keeps it for A/B runs.
-        entry_classes=None if env_bool("bench", "BENCH_CC", "0")
-        else ("normal",),
-    )
     run = build_scan_rounds(steady_cfg, spec, mesh, rounds=inner)
     args = (prop_len, prop_data, zp, z2, no_hup, no_tick, keep)
 
@@ -527,8 +567,14 @@ def main() -> None:
     )
     from etcd_tpu.models.telemetry import init_telemetry, telemetry_report
 
+    # donate the fleet carry AND, when the plane is on, the telemetry
+    # carry (positional arg 10): its birth ring / per-node lanes are
+    # fleet-scaled and exclusively threaded, so leaving it undonated
+    # double-buffers the plane at fleet C (the donation auditor's
+    # completeness rule — etcd_tpu/analysis/audit.py — flags exactly
+    # this). Never donate the slot while it rides as None.
     met_step = jax.jit(build_metered_round(cfg, spec, with_telemetry=telem),
-                       donate_argnums=(0, 1))
+                       donate_argnums=(0, 1, 10) if telem else (0, 1))
     metrics = zero_metrics()
     tele = init_telemetry(spec, state, buckets=telem_buckets) if telem \
         else None
@@ -611,10 +657,14 @@ def main() -> None:
         # normalized by the bare round like the telemetry probe
         from etcd_tpu.models.blackbox import init_blackbox
 
+        # same donation rule as met_step: the EventRing carry (arg 11,
+        # [W, M, C]) is fleet-scaled and exclusively threaded; without
+        # telemetry the tele slot (10) is filled POSITIONALLY with None
+        # below and stays undonated
         bb_step = jax.jit(
             build_metered_round(cfg, spec, with_telemetry=telem,
                                 with_blackbox=True),
-            donate_argnums=(0, 1))
+            donate_argnums=(0, 1, 10, 11) if telem else (0, 1, 11))
         bb = init_blackbox(spec, state)
         bmetrics = zero_metrics()
 
@@ -624,8 +674,10 @@ def main() -> None:
                 state, inbox, bmetrics, tele, bb = bb_step(
                     state, inbox, *args, bmetrics, tele, bb)
             else:
+                # tele rides positionally as None so the ring lands at
+                # the donated arg 11 slot (keyword args cannot donate)
                 state, inbox, bmetrics, bb = bb_step(
-                    state, inbox, *args, bmetrics, blackbox=bb)
+                    state, inbox, *args, bmetrics, None, bb)
 
         bb_round()  # compile + warm
         jax.block_until_ready(bmetrics.commits)

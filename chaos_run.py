@@ -51,10 +51,24 @@ for the first CHAOS_BLACKBOX_K violating groups; only those groups'
 rings cross PCIe) into the JSON line. CHAOS_BLACKBOX_WINDOW sets the
 ring depth W (2..256, default 32). Bit-identical state trajectory.
 
+Fault mix and geometry knobs: CHAOS_DROP / CHAOS_DELAY / CHAOS_PART set
+the per-round drop/delay/partition probabilities (defaults 0.02 / 0.05
+/ 0.1); CHAOS_SEED seeds the fault PRNG; CHAOS_LIVENESS_FRAC sets the
+per-epoch commit-liveness floor (default 0.2, or the membership tier's
+conscious 0.1); CHAOS_L sets the log ring length (default 16);
+CHAOS_BOUND caps the per-member inbox (default M-1); CHAOS_CHUNKS
+splits the fleet into HLO-temp-bounding chunks (defaults to the
+bench-proven 131072-wide chunks above 262k groups on accelerators);
+CHAOS_SYNC=1 forces synchronous dispatch; CHAOS_LEASE=0 skips the
+lease-read tier.
+
 All knobs are validated up front: a probability outside [0, 1], a boost
 below 1, an unknown mix/durability name, a TELEM value that is not 0/1,
 or an out-of-range APPLY_*/TELEM_* value exits 2 before any device
-work.
+work. ``--preflight`` additionally runs the donation + one-trace
+auditors (etcd_tpu/analysis/audit.py) on the exact epoch program the
+knobs select, at a small probe C, and exits 1 on a contract violation
+— a long TPU soak fails in seconds instead of hours.
 """
 from __future__ import annotations
 
@@ -107,6 +121,16 @@ def main() -> int:
         MemberChaosConfig,
         RaftConfig,
     )
+
+    # --preflight is the only accepted argument (everything else is
+    # knob-driven); an unknown flag exits 2 like a bad knob would
+    preflight = "--preflight" in sys.argv[1:]
+    unknown = [a for a in sys.argv[1:] if a != "--preflight"]
+    if unknown:
+        print(f"chaos_run: unknown argument(s): {' '.join(unknown)} "
+              f"(only --preflight; configure via CHAOS_* knobs)",
+              file=sys.stderr)
+        return 2
 
     # ---- knob validation, before any device work (exit code 2).
     # Name/shape validation is delegated to the config dataclasses' own
@@ -212,6 +236,35 @@ def main() -> int:
     # always passed — its crash-boost knobs target snapshot windows in
     # pure crash runs too (run_chaos gates the palette on member_p)
     crash_cfg = crash_knobs if crash_p > 0 else None
+
+    if preflight:
+        # audit the EXACT epoch program these knobs select — same
+        # structure flags run_chaos will derive, at a small probe C —
+        # before the fleet is allocated at CHAOS_C (donation + one-trace
+        # contracts; etcd_tpu/analysis/audit.py)
+        from etcd_tpu.analysis.audit import run_preflight
+        from etcd_tpu.analysis.programs import chaos_epoch_program
+
+        inst = chaos_epoch_program(
+            cfg, spec,
+            with_delay=delay_p > 0,
+            with_crash=crash_p > 0,
+            with_member=member_p > 0,
+            with_telemetry=telem,
+            with_blackbox=blackbox,
+            blackbox_window=blackbox_window,
+            buckets=telem_buckets,
+        )
+        finds = run_preflight(
+            inst, progress=lambda m: print(f"# {m}", file=sys.stderr))
+        if finds:
+            for f in finds:
+                print(f, file=sys.stderr)
+            print(f"# preflight: {len(finds)} contract violation(s)",
+                  file=sys.stderr)
+            return 1
+        print("# preflight ok", file=sys.stderr)
+
     t0 = time.perf_counter()
     rep = run_chaos(
         spec, cfg, C=C, rounds=rounds, epoch_len=epoch_len, heal_len=heal_len,

@@ -1,0 +1,21 @@
+"""Trace-contract static analysis plane.
+
+Two levels (see README "Static analysis"):
+
+  * ``analysis.lint`` — pure-AST lint rules over the repo source
+    (knob hygiene, host-sync discipline in traced modules, leftover
+    debug prints, undefined names, dead knobs). Stdlib-only: importing
+    it never touches jax.
+  * ``analysis.audit`` — program auditors that lower the canonical
+    entry programs and statically check the contracts the perf claims
+    rest on: one-trace/many-operands, donation completeness with no
+    double-donation, no host callbacks, zero cross-shard collectives in
+    the steady-state sharded round, and pack/wire width contracts.
+
+CLI: ``python -m etcd_tpu.analysis`` (exit 0 clean, 1 findings, 2 bad
+knobs). Knobs: ANALYSIS_RULES / ANALYSIS_PATHS / ANALYSIS_AUDIT /
+ANALYSIS_AUDITORS / ANALYSIS_PROGRAMS via utils/knobs.
+"""
+from etcd_tpu.analysis.lint import Finding, lint_paths, run_lint, RULES
+
+__all__ = ["Finding", "lint_paths", "run_lint", "RULES"]

@@ -1,0 +1,99 @@
+"""CLI for the static-analysis plane: ``python -m etcd_tpu.analysis``.
+
+Runs level 1 (source lint, etcd_tpu/analysis/lint.py) and level 2
+(trace/HLO auditors, etcd_tpu/analysis/audit.py) over the repo and the
+canonical program registry, printing one ``path:line: [rule] message``
+row per finding to stdout. Exit status: 0 clean, 1 findings, 2 bad
+knobs (the repo-wide exit-2 validation convention).
+
+Knobs (all validated before any heavy work starts):
+  ANALYSIS_LINT      run the source lint pass              [1]
+  ANALYSIS_RULES     comma list of lint rules, or "all"    [all]
+  ANALYSIS_PATHS     comma list of lint targets (relative
+                     to the repo root); empty = defaults   []
+  ANALYSIS_AUDIT     run the trace/HLO auditors            [1]
+  ANALYSIS_AUDITORS  comma list of auditors, or "all"      [all]
+  ANALYSIS_PROGRAMS  comma list of registry programs, or
+                     "all"                                 [all]
+
+The audit pass needs a device backend; the CLI forces the hermetic
+8-virtual-device CPU platform (the dryrun convention) unless the caller
+pinned JAX_PLATFORMS. The full audit sweep traces every registry
+program and compiles the sharded ones — minutes of single-core work;
+``ANALYSIS_AUDIT=0`` (lint only) is the fast tier run_smoke.sh uses.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+
+def _repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def _device_setup() -> None:
+    """Hermetic CPU backend with 8 virtual devices for the mesh-sharded
+    programs (same convention as __graft_entry__ and conftest.py). Must
+    run before jax initialises a backend."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    if "JAX_PLATFORMS" not in os.environ:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    from etcd_tpu.utils.cache import configure_compile_cache
+
+    configure_compile_cache(str(_repo_root()))
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    prog = "analysis"
+    if argv:
+        print(f"{prog}: takes no arguments (configure via ANALYSIS_* "
+              f"knobs; see etcd_tpu/analysis/__main__.py)", file=sys.stderr)
+        return 2
+
+    from etcd_tpu.analysis.audit import AUDITOR_NAMES, run_audits
+    from etcd_tpu.analysis.lint import DEFAULT_LINT_TARGETS, RULES, run_lint
+    from etcd_tpu.analysis.programs import PROGRAM_NAMES
+    from etcd_tpu.utils.knobs import env_bool, env_list, env_str, knob_error
+
+    do_lint = env_bool(prog, "ANALYSIS_LINT", "1")
+    rules = env_list(prog, "ANALYSIS_RULES", "all", tuple(RULES))
+    raw_paths = env_str(prog, "ANALYSIS_PATHS", "")
+    do_audit = env_bool(prog, "ANALYSIS_AUDIT", "1")
+    auditors = env_list(prog, "ANALYSIS_AUDITORS", "all", AUDITOR_NAMES)
+    programs = env_list(prog, "ANALYSIS_PROGRAMS", "all", PROGRAM_NAMES)
+
+    root = _repo_root()
+    targets = tuple(p.strip() for p in raw_paths.split(",") if p.strip()) \
+        or DEFAULT_LINT_TARGETS
+    for t in targets:
+        if not (root / t).exists():
+            knob_error(prog, f"ANALYSIS_PATHS: {t!r} does not exist "
+                             f"under {root}")
+
+    findings = []
+    if do_lint:
+        print(f"{prog}: linting {len(targets)} target(s), "
+              f"{len(rules)} rule(s)", file=sys.stderr)
+        findings += run_lint(root, targets, rules)
+    if do_audit:
+        _device_setup()
+        findings += run_audits(
+            programs, auditors,
+            progress=lambda m: print(f"{prog}: {m}", file=sys.stderr),
+        )
+
+    for f in findings:
+        print(f)
+    print(f"{prog}: {len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

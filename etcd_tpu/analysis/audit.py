@@ -1,0 +1,533 @@
+"""Level-2 auditors: trace/HLO contracts checked on the canonical programs.
+
+Where :mod:`etcd_tpu.analysis.lint` reads source text, the auditors here
+lower the registry's real entry programs (:mod:`etcd_tpu.analysis.programs`)
+and assert the contracts the repo's performance story rests on:
+
+  one_trace    the lowered program is BIT-IDENTICAL across runtime-operand
+               value variants — fault probabilities, palettes and mode
+               switches must be operands, not closure constants, or every
+               mix pays its own multi-second trace (and a baked
+               numpy-array constant shows up as a dense<...> literal in
+               exactly one variant's StableHLO)
+  donation     every fleet-scaled ([..., C]) carried argument is donated
+               (or carries a written justification), no buffer sits at
+               two donated positions (the PR-9 shared-zeros crash class),
+               no donated buffer is passed live elsewhere unless
+               allowlisted, and every donated leaf has a shape/dtype-
+               compatible output slot to alias
+  transfers    the compiled round body contains no host callbacks /
+               infeed / outfeed, and the program returns exactly its
+               declared output arity (the counted device-to-host bound)
+  collectives  the steady-state sharded round's post-SPMD HLO contains
+               ZERO cross-shard collectives — the machine check for
+               MULTICHIP_SCALING_r05 (clusters are independent; only the
+               invariant psum may cross the mesh, and it is not in the
+               round program)
+  widths       the packed-state bit widths, the i16 narrow-plane range
+               class and the wire split registry cross-check against the
+               durability tables in models/state.py
+
+Auditors return :class:`etcd_tpu.analysis.lint.Finding` rows (path =
+``<program-name>``), so the CLI reports both levels uniformly.
+
+A note on cost: tracing is the expensive step (the full chaos epoch is
+~12 s of single-core time even at probe shapes), so each program is
+traced ONCE per operand set and the trace is shared by every auditor —
+lowered text derives from the cached trace without retracing.
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable
+
+from etcd_tpu.analysis.lint import Finding
+from etcd_tpu.analysis.programs import (
+    PROGRAM_NAMES,
+    ProgramInstance,
+    get_program,
+)
+
+__all__ = [
+    "AUDITOR_NAMES", "TracedProgram", "jaxpr_fingerprint",
+    "audit_one_trace", "audit_donation", "audit_transfers",
+    "audit_collectives", "audit_widths", "run_audits", "run_preflight",
+]
+
+AUDITOR_NAMES = ("widths", "donation", "one_trace", "transfers",
+                 "collectives")
+
+
+# ---------------------------------------------------------------------------
+# shared trace cache
+# ---------------------------------------------------------------------------
+
+class TracedProgram:
+    """One program's traces, computed lazily and shared across auditors
+    (label None = the base operand set)."""
+
+    def __init__(self, prog: ProgramInstance):
+        self.prog = prog
+        self._traced: dict = {}
+
+    def args(self, label: str | None):
+        if label is None:
+            return self.prog.base
+        return dict(self.prog.variants)[label]
+
+    def trace(self, label: str | None = None):
+        if label not in self._traced:
+            self._traced[label] = self.prog.jitted.trace(*self.args(label))
+        return self._traced[label]
+
+    def lowered_text(self, label: str | None = None) -> str:
+        return self.trace(label).lower().as_text()
+
+
+def _subjaxprs(v):
+    if hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+        yield v.jaxpr               # ClosedJaxpr
+    elif hasattr(v, "eqns"):
+        yield v                     # bare Jaxpr
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _subjaxprs(x)
+
+
+def jaxpr_fingerprint(closed) -> tuple:
+    """Structural fingerprint of a (closed) jaxpr: the recursive
+    primitive histogram. Cheap against multi-MB jaxpr text, and enough
+    to localise a structure divergence to the primitives that changed."""
+    counts: dict[str, int] = {}
+
+    def walk(j):
+        for eqn in j.eqns:
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+            for v in eqn.params.values():
+                for sub in _subjaxprs(v):
+                    walk(sub)
+
+    walk(closed.jaxpr if hasattr(closed, "jaxpr") else closed)
+    return tuple(sorted(counts.items()))
+
+
+def _find(prog: ProgramInstance, rule: str, msg: str) -> Finding:
+    return Finding(rule=rule, path=f"<{prog.name}>", line=0, message=msg)
+
+
+# ---------------------------------------------------------------------------
+# one-trace
+# ---------------------------------------------------------------------------
+
+def _first_diff(a: str, b: str) -> str:
+    la, lb = a.splitlines(), b.splitlines()
+    for i, (x, y) in enumerate(zip(la, lb)):
+        if x != y:
+            return (f"first divergence at lowered line {i + 1}: "
+                    f"{x.strip()[:120]!r} vs {y.strip()[:120]!r}")
+    return (f"lowered programs differ in length: "
+            f"{len(la)} vs {len(lb)} lines")
+
+
+def audit_one_trace(tp: TracedProgram) -> list[Finding]:
+    """The lowered program must not depend on operand VALUES. Compares
+    the jaxpr primitive histogram (fast, localises the divergence) and
+    the full lowered StableHLO text (catches value leaks the histogram
+    cannot — e.g. an operand baked to a ``dense<...>`` constant)."""
+    prog = tp.prog
+    out = []
+    if len(prog.variants) < 2:
+        out.append(_find(prog, "audit-one-trace",
+                         "program declares fewer than 3 operand sets; "
+                         "the one-trace contract cannot be checked"))
+        return out
+    base_fp = jaxpr_fingerprint(tp.trace().jaxpr)
+    base_txt = tp.lowered_text()
+    for label, _ in prog.variants:
+        fp = jaxpr_fingerprint(tp.trace(label).jaxpr)
+        if fp != base_fp:
+            b, v = dict(base_fp), dict(fp)
+            delta = sorted(k for k in set(b) | set(v)
+                           if b.get(k, 0) != v.get(k, 0))
+            out.append(_find(
+                prog, "audit-one-trace",
+                f"jaxpr structure diverged for variant {label!r}: "
+                f"primitive counts changed for {delta[:8]}"))
+            continue
+        txt = tp.trace(label).lower().as_text()
+        if txt != base_txt:
+            out.append(_find(
+                prog, "audit-one-trace",
+                f"lowered program is not bit-identical for variant "
+                f"{label!r} ({_first_diff(base_txt, txt)}) — an operand "
+                f"value leaked into the trace"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# donation
+# ---------------------------------------------------------------------------
+
+def _out_list(tr) -> list:
+    """Top-level output elements of a Traced: out_info is the output
+    pytree, which is a bare OutInfo (not a 1-tuple) for single-output
+    programs."""
+    info = tr.out_info
+    return list(info) if isinstance(info, (tuple, list)) else [info]
+
+
+def _tree_sig(tree):
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    return (str(treedef),
+            tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+
+
+def _leaf_pointers(argnum, tree):
+    """(pointer, argnum, leaf-path) rows; leaves whose runtime does not
+    expose a buffer pointer (sharded arrays, committed multi-device)
+    are skipped — pointer identity is only meaningful single-device."""
+    import jax
+
+    rows = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        try:
+            ptr = leaf.unsafe_buffer_pointer()
+        except Exception:
+            continue
+        rows.append((ptr, argnum, jax.tree_util.keystr(path)))
+    return rows
+
+
+def audit_donation(tp: TracedProgram) -> list[Finding]:
+    prog = tp.prog
+    import jax
+
+    out: list[Finding] = []
+    tr = tp.trace()
+    out_sigs = [_tree_sig(o) for o in _out_list(tr)]
+    arg_sigs = [_tree_sig(a) for a in prog.base]
+    carried = {i for i, s in enumerate(arg_sigs) if s in out_sigs}
+
+    def fleet_scaled(arg) -> bool:
+        return any(l.ndim >= 1 and l.shape[-1] == prog.C
+                   for l in jax.tree.leaves(arg))
+
+    # completeness: every fleet-scaled carry is donated or justified
+    for i in sorted(carried):
+        if i in prog.donate or not fleet_scaled(prog.base[i]):
+            continue
+        if i in prog.undonated_ok:
+            continue
+        out.append(_find(
+            prog, "audit-donation",
+            f"arg {i} is a fleet-scaled carry (trailing C={prog.C} "
+            f"leaves, aval-identical output) but is not donated and "
+            f"carries no justification — at fleet C this double-buffers "
+            f"the resident state"))
+    # a donated arg that is not carried has no output to alias into
+    for i in prog.donate:
+        if i not in carried:
+            out.append(_find(
+                prog, "audit-donation",
+                f"arg {i} is donated but no output element matches its "
+                f"structure — the donation can never alias and XLA will "
+                f"warn (or reject) at runtime"))
+
+    # double-donation (the PR-9 crash class) + donated-live aliases
+    donated_rows = []
+    for i in prog.donate:
+        donated_rows += _leaf_pointers(i, prog.base[i])
+    by_ptr: dict[int, list] = {}
+    for ptr, argnum, path in donated_rows:
+        by_ptr.setdefault(ptr, []).append((argnum, path))
+    for ptr, sites in by_ptr.items():
+        if len(sites) > 1:
+            locs = ", ".join(f"arg {a}{p}" for a, p in sites)
+            out.append(_find(
+                prog, "audit-donation",
+                f"one buffer sits at {len(sites)} donated positions "
+                f"({locs}) — donating it twice aliases two live results "
+                f"into one allocation"))
+    live_rows = []
+    for i, arg in enumerate(prog.base):
+        if i not in prog.donate:
+            live_rows += _leaf_pointers(i, arg)
+    donated_ptrs = {ptr: (a, p) for ptr, a, p in donated_rows}
+    flagged = set()
+    for ptr, argnum, path in live_rows:
+        hit = donated_ptrs.get(ptr)
+        if hit is None:
+            continue
+        d_arg, d_path = hit
+        key = (d_arg, argnum)
+        if key in prog.live_alias_ok or key in flagged:
+            continue
+        flagged.add(key)
+        out.append(_find(
+            prog, "audit-donation",
+            f"donated arg {d_arg}{d_path} shares a buffer with live "
+            f"arg {argnum}{path} — the runtime may reuse the buffer "
+            f"while the live operand still reads it (allowlist via "
+            f"live_alias_ok with a reason if the backend tolerates it)"))
+
+    # alias validity: every donated leaf needs a compatible output slot
+    out_leaf_counts: dict[tuple, int] = {}
+    for o in _out_list(tr):
+        for l in jax.tree.leaves(o):
+            k = (tuple(l.shape), str(l.dtype))
+            out_leaf_counts[k] = out_leaf_counts.get(k, 0) + 1
+    for i in prog.donate:
+        for l in jax.tree.leaves(prog.base[i]):
+            k = (tuple(l.shape), str(l.dtype))
+            if out_leaf_counts.get(k, 0) > 0:
+                out_leaf_counts[k] -= 1
+            else:
+                out.append(_find(
+                    prog, "audit-donation",
+                    f"donated leaf of arg {i} with shape/dtype "
+                    f"{k} has no remaining output slot to alias — the "
+                    f"donation is unusable"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# transfers
+# ---------------------------------------------------------------------------
+
+_HOST_PRIMITIVES = frozenset({
+    "debug_callback", "pure_callback", "io_callback", "host_callback",
+    "outside_call", "infeed", "outfeed", "debug_print",
+})
+_CALLBACK_TARGET_RE = re.compile(r'call_target_name\s*=\s*"([^"]+)"')
+
+
+def audit_transfers(tp: TracedProgram) -> list[Finding]:
+    """No host round-trips inside the compiled round body, and the
+    program returns exactly its declared output arity — the counted
+    bound on what can cross device-to-host per call."""
+    prog = tp.prog
+    out = []
+    tr = tp.trace()
+    fp = dict(jaxpr_fingerprint(tr.jaxpr))
+    for name in sorted(_HOST_PRIMITIVES & set(fp)):
+        out.append(_find(
+            prog, "audit-transfers",
+            f"traced program contains host primitive {name!r} (x{fp[name]})"
+            f" — a synchronous host round-trip inside the round body"))
+    txt = tp.lowered_text()
+    for m in _CALLBACK_TARGET_RE.finditer(txt):
+        target = m.group(1)
+        if "callback" in target or target.startswith("xla_python"):
+            out.append(_find(
+                prog, "audit-transfers",
+                f"lowered program custom_call targets {target!r} — a "
+                f"host callback in the compiled body"))
+    for op in ("stablehlo.infeed", "stablehlo.outfeed"):
+        if op in txt:
+            out.append(_find(prog, "audit-transfers",
+                             f"lowered program contains {op}"))
+    n_out = len(_out_list(tr))
+    if n_out != prog.expected_outputs:
+        out.append(_find(
+            prog, "audit-transfers",
+            f"program returns {n_out} top-level results, declared bound "
+            f"is {prog.expected_outputs} — an undeclared result widens "
+            f"the per-call device-to-host surface"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_RE = re.compile(
+    r"=\s*\S*\s*(all-reduce|all-gather|all-to-all|collective-permute|"
+    r"reduce-scatter|collective-broadcast)\b")
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{(.*?)\}\s*[,)]")
+_SOURCE_TARGET_RE = re.compile(r"source_target_pairs=\{(.*?)\}")
+
+
+def _crosses_shards(line: str, op: str) -> bool:
+    """Refine a collective-op match: only groups spanning >= 2 shards
+    count as cross-shard traffic (XLA emits degenerate single-member
+    groups for some rewrites)."""
+    if op == "collective-permute":
+        m = _SOURCE_TARGET_RE.search(line)
+        return bool(m and m.group(1).strip())
+    m = _REPLICA_GROUPS_RE.search(line)
+    if not m:
+        return True   # no groups attribute = one flat group over all shards
+    body = m.group(1)
+    groups = re.findall(r"\{([^{}]*)\}", body) or [body]
+    return any(len([t for t in g.split(",") if t.strip()]) >= 2
+               for g in groups)
+
+
+def audit_collectives(tp: TracedProgram) -> list[Finding]:
+    """Zero cross-shard collectives in the steady-state sharded round's
+    post-SPMD HLO: clusters are independent, so any collective here is
+    sharding-rule drift paying ICI/DCN bandwidth every round
+    (MULTICHIP_SCALING_r05, machine-checked)."""
+    prog = tp.prog
+    if prog.mesh is None:
+        return []
+    out = []
+    hlo = tp.trace().lower().compile().as_text()
+    for line in hlo.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m and _crosses_shards(line, m.group(1)):
+            out.append(_find(
+                prog, "audit-collectives",
+                f"cross-shard {m.group(1)} in the compiled round: "
+                f"{line.strip()[:140]}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# widths
+# ---------------------------------------------------------------------------
+
+def audit_widths(spec=None, election_tick: int = 10, *,
+                 durable=None, capped=None, replay=None, volatile=None,
+                 wide_expected=("applied_hash", "snap_hash", "log_data"),
+                 wire_split=None) -> list[Finding]:
+    """Cross-check the packed-state plan and wire registries against the
+    durability tables (models/state.py). The keyword overrides exist for
+    the seeded-violation tests — production callers pass nothing and the
+    real tables are audited."""
+    from etcd_tpu.models import state as st
+    from etcd_tpu.types import MSG_SNAP, Msg, Spec, WIRE_SPLIT
+
+    spec = spec or Spec(M=5, L=16, E=1, K=2, W=4, R=2, A=2)
+    durable = tuple(durable if durable is not None else st.DURABLE_FIELDS)
+    capped = tuple(capped if capped is not None else st.CAPPED_FIELDS)
+    replay = tuple(replay if replay is not None else st.REPLAY_FIELDS)
+    volatile = tuple(volatile if volatile is not None else st.VOLATILE_FIELDS)
+    wire_split = wire_split if wire_split is not None else WIRE_SPLIT
+
+    def find(msg):
+        return Finding(rule="audit-widths", path="<state-tables>", line=0,
+                       message=msg)
+
+    out: list[Finding] = []
+    fields = set(st.NodeState.__dataclass_fields__)
+    tables = {"DURABLE": durable, "CAPPED": capped, "REPLAY": replay,
+              "VOLATILE": volatile}
+    seen: dict[str, str] = {}
+    for tname, tbl in tables.items():
+        for f in tbl:
+            if f not in fields:
+                out.append(find(f"{tname}_FIELDS names {f!r}, not a "
+                                f"NodeState field"))
+            if f in seen:
+                out.append(find(f"{f!r} is classified both {seen[f]} and "
+                                f"{tname} — the durability partition must "
+                                f"be disjoint"))
+            seen[f] = tname
+    missing = fields - set(seen)
+    if missing:
+        out.append(find(f"NodeState fields with no durability class: "
+                        f"{sorted(missing)} — a crash would silently "
+                        f"preserve-or-wipe them by accident"))
+
+    try:
+        bit_rows, _n_lanes, narrow_rows, _n_narrow, wide_rows, _n_wide = \
+            st.pack_plan(spec)
+    except ValueError as e:
+        out.append(find(f"pack_plan coverage check failed: {e}"))
+        return out
+
+    # id-valued rows must hold 0..M-1 (+bias for the NONE_ID shift)
+    id_rows = {"nid", "lead", "vote", "lead_transferee", "ro_from",
+               "ro_pend_from"}
+    for name, bits, bias, _slots in bit_rows:
+        if name in id_rows and spec.M - 1 + bias >= (1 << bits):
+            out.append(find(
+                f"packed row {name!r} has {bits} bits (bias {bias}) but "
+                f"must store ids up to {spec.M - 1 + bias} at M={spec.M}"))
+        if name in st._PACK_SATURATING and bits != st.PACK_TIMER_BITS:
+            out.append(find(
+                f"saturating timer {name!r} packs at {bits} bits, not "
+                f"PACK_TIMER_BITS={st.PACK_TIMER_BITS}"))
+    if 2 * election_tick >= (1 << st.PACK_TIMER_BITS):
+        out.append(find(
+            f"2*election_tick={2 * election_tick} does not fit the "
+            f"{st.PACK_TIMER_BITS}-bit packed timer lane — the "
+            f"randomized timeout draw in [T, 2T) would corrupt"))
+
+    narrow_names = {r[0] for r in narrow_rows}
+    bool_in_narrow = narrow_names & set(st._PACK_BOOL_FIELDS)
+    if bool_in_narrow:
+        out.append(find(
+            f"bool fields {sorted(bool_in_narrow)} sit in the i16 narrow "
+            f"plane — they belong in the bit plane (16x denser)"))
+
+    wide_names = tuple(r[0] for r in wide_rows)
+    if set(wide_names) != set(wide_expected):
+        out.append(find(
+            f"wide (full-i32) plane holds {sorted(wide_names)}, expected "
+            f"{sorted(wide_expected)} — a field moved across the "
+            f"int16-range contract boundary without review"))
+    persistent = set(durable) | set(replay)
+    for name in wide_names:
+        if name not in persistent:
+            out.append(find(
+                f"wide field {name!r} is not DURABLE/REPLAY — full-width "
+                f"volatile state contradicts the diet rationale"))
+
+    msg_fields = set(Msg.__dataclass_fields__)
+    for (f, t) in wire_split:
+        if f not in msg_fields:
+            out.append(find(f"WIRE_SPLIT names {f!r}, not a Msg field"))
+    if ("commit", MSG_SNAP) not in wire_split:
+        out.append(find(
+            "WIRE_SPLIT lost ('commit', MSG_SNAP) — the MsgSnap applied "
+            "hash would silently truncate on the int16 wire (the 81d0b1e "
+            "bug class)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def run_audits(programs: Iterable[str] = PROGRAM_NAMES,
+               auditors: Iterable[str] = AUDITOR_NAMES,
+               progress=None) -> list[Finding]:
+    """Run the selected auditors over the selected registry programs.
+    `progress` (optional callable) receives one line per step."""
+    auditors = tuple(auditors)
+    say = progress or (lambda _msg: None)
+    findings: list[Finding] = []
+    if "widths" in auditors:
+        say("audit: widths <state-tables>")
+        findings += audit_widths()
+    per_program = [a for a in ("donation", "one_trace", "transfers",
+                               "collectives") if a in auditors]
+    if not per_program:
+        return findings
+    for name in programs:
+        say(f"audit: tracing {name}")
+        tp = TracedProgram(get_program(name))
+        for a in per_program:
+            if a == "collectives" and tp.prog.mesh is None:
+                continue
+            say(f"audit: {a} {name}")
+            findings += globals()[f"audit_{a}"](tp)
+    return findings
+
+
+def run_preflight(prog: ProgramInstance, progress=None) -> list[Finding]:
+    """Driver preflight (bench/chaos_run --preflight): donation and
+    one-trace auditors over the exact program the driver is about to
+    execute, at probe operand shapes."""
+    say = progress or (lambda _msg: None)
+    tp = TracedProgram(prog)
+    say(f"preflight: donation {prog.name}")
+    findings = audit_donation(tp)
+    say(f"preflight: one-trace {prog.name} "
+        f"({1 + len(prog.variants)} operand sets)")
+    findings += audit_one_trace(tp)
+    return findings

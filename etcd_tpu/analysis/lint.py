@@ -1,0 +1,550 @@
+"""Level-1 lint: pluggable AST rules over the repo source.
+
+Stdlib-only (ast + tokenize) so the lint tier costs milliseconds and
+never initializes jax. Each rule is a registered checker over one
+parsed file; findings carry (rule, path, line, message) and print as
+``path:line: [rule] message``.
+
+Suppressions are inline comments the linter itself parses:
+
+  * ``# lint: allow(rule) -- reason``         this line only
+  * ``# lint: allow-def(rule) -- reason``     the next ``def`` (whole body)
+  * ``# lint: allow-module(rule) -- reason``  the whole file
+
+A suppression without a ``-- reason`` justification is itself a finding
+(rule ``suppression``): the point of the mechanism is that every
+exemption carries its rationale at the use site.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+
+# ---------------------------------------------------------------------------
+# findings + suppressions
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+_ALLOW_RE = re.compile(
+    r"lint:\s*(allow(?:-def|-module)?)\s*\(\s*([\w,\s-]+?)\s*\)"
+    r"\s*(?:--\s*(\S.*))?$")
+
+
+class Suppressions:
+    """Per-file suppression table built from comment tokens."""
+
+    def __init__(self, source: str, tree: ast.Module, path: str):
+        self.line_allow: dict[int, set[str]] = {}
+        self.module_allow: set[str] = set()
+        self.findings: list[Finding] = []
+        def_spans = [(n.lineno, n.end_lineno or n.lineno)
+                     for n in ast.walk(tree)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+        def_spans.sort()
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except tokenize.TokenError:
+            return
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ALLOW_RE.search(tok.string)
+            if m is None:
+                continue
+            kind, rules_s, reason = m.groups()
+            rules = {r.strip() for r in rules_s.split(",") if r.strip()}
+            line = tok.start[0]
+            if not reason:
+                self.findings.append(Finding(
+                    "suppression", path, line,
+                    f"{kind}({','.join(sorted(rules))}) has no "
+                    "'-- justification'; every exemption must say why"))
+                continue
+            if kind == "allow-module":
+                self.module_allow |= rules
+            elif kind == "allow":
+                self.line_allow.setdefault(line, set()).update(rules)
+            else:  # allow-def: attach to the first def at/after the comment
+                span = next(((s, e) for s, e in def_spans if s >= line),
+                            None)
+                if span is None:
+                    self.findings.append(Finding(
+                        "suppression", path, line,
+                        "allow-def comment has no following def"))
+                    continue
+                for ln in range(span[0], span[1] + 1):
+                    self.line_allow.setdefault(ln, set()).update(rules)
+
+    def allows(self, rule: str, line: int) -> bool:
+        return (rule in self.module_allow
+                or rule in self.line_allow.get(line, ()))
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+RULES: dict[str, "object"] = {}
+
+
+def rule(name: str):
+    def deco(fn):
+        RULES[name] = fn
+        return fn
+    return deco
+
+
+def _is_traced_module(rel: str) -> bool:
+    """The modules whose code runs under jit in the round/epoch programs
+    (plus their host edges, which must be explicitly suppressed)."""
+    return (rel.startswith("etcd_tpu/models/")
+            or rel.startswith("etcd_tpu/parallel/")
+            or rel == "etcd_tpu/harness/chaos.py")
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# --- rule: env-knob --------------------------------------------------------
+
+# Runtime-platform plumbing, not behavior knobs: reading these raw is the
+# documented pattern (bench/chaos_run JAX_PLATFORMS forwarding,
+# verify_drive's XLA_FLAGS host-device-count append).
+ENV_ALLOWLIST = frozenset({"JAX_PLATFORMS", "XLA_FLAGS"})
+
+
+@rule("env-knob")
+def check_env_knob(rel: str, tree: ast.Module, source: str):
+    """Raw os.environ value reads outside utils/knobs.py. Presence
+    checks (``"X" in os.environ``) and child-env construction
+    (``dict(os.environ, ...)``) stay legal — only value reads must go
+    through the env_* helpers so a typo'd knob exits 2 instead of
+    silently selecting a default (the PR-10 knob-hygiene contract)."""
+    if rel == "etcd_tpu/utils/knobs.py":
+        return
+    for node in ast.walk(tree):
+        key = None
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and _dotted(node.value) in ("os.environ", "environ")):
+            key = node.slice
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr in ("get", "setdefault")
+              and _dotted(node.func.value) in ("os.environ", "environ")):
+            key = node.args[0] if node.args else None
+        elif (isinstance(node, ast.Call)
+              and _dotted(node.func) in ("os.getenv", "getenv")):
+            key = node.args[0] if node.args else None
+        else:
+            continue
+        if (isinstance(key, ast.Constant) and isinstance(key.value, str)
+                and key.value in ENV_ALLOWLIST):
+            continue
+        name = (key.value if isinstance(key, ast.Constant) else "<dynamic>")
+        yield Finding(
+            "env-knob", rel, node.lineno,
+            f"raw os.environ read of {name!r}; route through "
+            "etcd_tpu.utils.knobs (env_int/env_float/env_bool/env_str) "
+            "so a bad value exits 2 before device work")
+
+
+# --- rule: host-sync -------------------------------------------------------
+
+_REDUCTIONS = frozenset({"sum", "max", "min", "mean", "any", "all", "prod",
+                         "item"})
+
+
+@rule("host-sync")
+def check_host_sync(rel: str, tree: ast.Module, source: str):
+    """Host-sync calls inside the traced-round modules: .item(),
+    np.asarray on device values, jax.device_get, and int()/float() over
+    an array reduction. Each one is a device->host transfer that blocks
+    the round pipeline; legitimate host edges (report paths, host
+    adapters) must carry an allow-def/allow-module justification."""
+    if not _is_traced_module(rel):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item" and not node.args):
+            yield Finding("host-sync", rel, node.lineno,
+                          ".item() forces a device->host sync")
+        elif dotted in ("np.asarray", "numpy.asarray", "np.array",
+                        "numpy.array"):
+            yield Finding("host-sync", rel, node.lineno,
+                          f"{dotted}(...) pulls the operand to host")
+        elif dotted in ("jax.device_get", "device_get"):
+            yield Finding("host-sync", rel, node.lineno,
+                          "jax.device_get is a device->host transfer")
+        elif (isinstance(node.func, ast.Name)
+              and node.func.id in ("int", "float") and node.args):
+            arg = node.args[0]
+            if (isinstance(arg, ast.Call)
+                    and isinstance(arg.func, ast.Attribute)
+                    and arg.func.attr in _REDUCTIONS):
+                yield Finding(
+                    "host-sync", rel, node.lineno,
+                    f"{node.func.id}(...{arg.func.attr}()) materializes a "
+                    "device reduction on host")
+
+
+# --- rule: debug-print -----------------------------------------------------
+
+
+@rule("debug-print")
+def check_debug_print(rel: str, tree: ast.Module, source: str):
+    """Leftover jax.debug.print / jax.debug.breakpoint / breakpoint():
+    debugging scaffolds that compile a host callback into the round
+    program (and tank TPU throughput) or stop a headless run."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted in ("jax.debug.print", "jax.debug.breakpoint",
+                      "debug.print", "debug.breakpoint"):
+            yield Finding("debug-print", rel, node.lineno,
+                          f"leftover {dotted}(...) compiles a host "
+                          "callback into the traced program")
+        elif dotted == "breakpoint":
+            yield Finding("debug-print", rel, node.lineno,
+                          "leftover breakpoint() call")
+
+
+# --- rule: undefined-name --------------------------------------------------
+
+_BUILTIN_EXTRAS = frozenset({
+    "__file__", "__name__", "__doc__", "__builtins__", "__spec__",
+    "__package__", "__loader__", "__path__", "__debug__",
+    "__annotations__", "__dict__", "__class__",
+})
+
+
+class _Scope:
+    def __init__(self, kind: str, parent: "_Scope | None"):
+        self.kind = kind  # module | function | class | comprehension
+        self.parent = parent
+        self.bound: set[str] = set()
+
+    def resolves(self, name: str) -> bool:
+        s: _Scope | None = self
+        while s is not None:
+            # class scopes are invisible to code nested inside them
+            # (real Python name resolution skips them for functions)
+            if s is self or s.kind != "class":
+                if name in s.bound:
+                    return True
+            s = s.parent
+        return False
+
+
+def _bind_target(scope: _Scope, node: ast.AST) -> None:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            scope.bound.add(n.id)
+        elif isinstance(n, (ast.MatchAs, ast.MatchStar)) and n.name:
+            scope.bound.add(n.name)
+        elif isinstance(n, ast.MatchMapping) and n.rest:
+            scope.bound.add(n.rest)
+
+
+class _NameChecker(ast.NodeVisitor):
+    """Undefined-name analysis (the PR-9 `margs` class: a name that is
+    never bound anywhere in scope, typically live only under an
+    env-gated branch so no default test trips it). Deliberately
+    flow-insensitive — a name bound ANYWHERE in the enclosing scope
+    chain resolves — so use-before-def ordering never false-positives;
+    only genuinely dangling names fire."""
+
+    def __init__(self, rel: str, builtins_set: frozenset):
+        self.rel = rel
+        self.builtins = builtins_set
+        self.findings: list[Finding] = []
+        self.scope = _Scope("module", None)
+
+    # -- scope construction: two-pass per scope (collect bindings, then
+    # -- visit loads) so forward references inside a scope resolve.
+
+    def _collect_stmt(self, scope: _Scope, stmt: ast.AST) -> None:
+        for n in self._shallow_walk(stmt):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                scope.bound.add(n.name)
+            elif isinstance(n, (ast.Import, ast.ImportFrom)):
+                for alias in n.names:
+                    base = (alias.asname or alias.name).split(".")[0]
+                    scope.bound.add(base)
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    _bind_target(scope, t)
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+                _bind_target(scope, n.target)
+            elif isinstance(n, ast.NamedExpr):
+                _bind_target(scope, n.target)
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                _bind_target(scope, n.target)
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if item.optional_vars is not None:
+                        _bind_target(scope, item.optional_vars)
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                scope.bound.add(n.name)
+            elif isinstance(n, (ast.Global, ast.Nonlocal)):
+                scope.bound.update(n.names)
+            elif isinstance(n, ast.Delete):
+                for t in n.targets:
+                    _bind_target(scope, t)
+            elif isinstance(n, (ast.MatchAs, ast.MatchStar,
+                                ast.MatchMapping)):
+                _bind_target(scope, n)
+
+    @staticmethod
+    def _shallow_walk(stmt: ast.AST):
+        """Walk a statement without descending into nested function /
+        class / lambda / comprehension scopes."""
+        stack = [stmt]
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(
+                    n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                        ast.Lambda, ast.ListComp, ast.SetComp, ast.DictComp,
+                        ast.GeneratorExp)):
+                continue  # yielded for its own binding; don't descend
+            stack.extend(ast.iter_child_nodes(n))
+
+    # -- visiting
+
+    def check_module(self, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            self._collect_stmt(self.scope, stmt)
+        self.generic_visit(tree)
+
+    def _enter_function(self, node, args: ast.arguments) -> None:
+        scope = _Scope("function", self.scope)
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            scope.bound.add(a.arg)
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            if isinstance(stmt, ast.stmt):
+                self._collect_stmt(scope, stmt)
+        prev, self.scope = self.scope, scope
+        # defaults/decorators/annotations evaluate in the ENCLOSING scope
+        # and are visited by the caller's generic traversal; here visit
+        # only the body.
+        for stmt in body:
+            self.visit(stmt)
+        self.scope = prev
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for d in list(node.args.defaults) + [d for d in
+                                             node.args.kw_defaults if d]:
+            self.visit(d)
+        self._enter_function(node, node.args)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        for d in list(node.args.defaults) + [d for d in
+                                             node.args.kw_defaults if d]:
+            self.visit(d)
+        scope = _Scope("function", self.scope)
+        for a in (list(node.args.posonlyargs) + list(node.args.args)
+                  + list(node.args.kwonlyargs)
+                  + ([node.args.vararg] if node.args.vararg else [])
+                  + ([node.args.kwarg] if node.args.kwarg else [])):
+            scope.bound.add(a.arg)
+        prev, self.scope = self.scope, scope
+        self.visit(node.body)
+        self.scope = prev
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for base in list(node.bases) + [k.value for k in node.keywords]:
+            self.visit(base)
+        scope = _Scope("class", self.scope)
+        for stmt in node.body:
+            self._collect_stmt(scope, stmt)
+        prev, self.scope = self.scope, scope
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope = prev
+
+    def _visit_comp(self, node) -> None:
+        scope = _Scope("comprehension", self.scope)
+        for gen in node.generators:
+            _bind_target(scope, gen.target)
+        prev, self.scope = self.scope, scope
+        for gen in node.generators:
+            self.visit(gen.iter)
+            for cond in gen.ifs:
+                self.visit(cond)
+        if isinstance(node, ast.DictComp):
+            self.visit(node.key)
+            self.visit(node.value)
+        else:
+            self.visit(node.elt)
+        self.scope = prev
+
+    visit_ListComp = visit_SetComp = visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        # annotations may be strings / forward refs under
+        # `from __future__ import annotations`; skip them
+        if node.value is not None:
+            self.visit(node.value)
+        self.visit(node.target)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if not isinstance(node.ctx, ast.Load):
+            return
+        name = node.id
+        if (self.scope.resolves(name) or name in self.builtins
+                or name in _BUILTIN_EXTRAS):
+            return
+        self.findings.append(Finding(
+            "undefined-name", self.rel, node.lineno,
+            f"name {name!r} is never bound in any enclosing scope "
+            "(NameError at runtime — the env-gated `margs` class)"))
+
+
+@rule("undefined-name")
+def check_undefined_name(rel: str, tree: ast.Module, source: str):
+    import builtins as _b
+    checker = _NameChecker(rel, frozenset(dir(_b)))
+    checker.check_module(tree)
+    yield from checker.findings
+
+
+# --- rule: dead-knob -------------------------------------------------------
+
+_ENV_HELPER_RE = re.compile(r"^_?env_(float|int|bool|str|list)$")
+_KNOB_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+
+@rule("dead-knob")
+def check_dead_knob(rel: str, tree: ast.Module, source: str):
+    """Driver knob hygiene (bench.py / chaos_run.py): a knob declared
+    via utils/knobs but whose parsed value is never read is dead weight;
+    a knob read but absent from the driver's module docstring is
+    invisible to users (the docstring IS the help text)."""
+    if rel not in ("bench.py", "chaos_run.py"):
+        return
+    doc = ast.get_docstring(tree) or ""
+    loads: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loads[node.id] = loads.get(node.id, 0) + 1
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        call = node.value
+        fn_name = None
+        if isinstance(call.func, ast.Name):
+            fn_name = call.func.id
+        elif isinstance(call.func, ast.Attribute):
+            fn_name = call.func.attr
+        if fn_name is None or not _ENV_HELPER_RE.match(fn_name):
+            continue
+        knob = next((a.value for a in call.args
+                     if isinstance(a, ast.Constant)
+                     and isinstance(a.value, str)
+                     and _KNOB_NAME_RE.match(a.value)), None)
+        if knob is None:
+            continue
+        if knob not in doc:
+            yield Finding(
+                "dead-knob", rel, node.lineno,
+                f"knob {knob} is read but not documented in the module "
+                "docstring (the driver's help text)")
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and loads.get(node.targets[0].id, 0) == 0):
+            yield Finding(
+                "dead-knob", rel, node.lineno,
+                f"knob {knob} is parsed into "
+                f"{node.targets[0].id!r} but the value is never used")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+DEFAULT_LINT_TARGETS = (
+    "bench.py", "chaos_run.py", "verify_drive.py", "__graft_entry__.py",
+    "etcd_tpu",
+)
+
+
+def lint_paths(root: Path, targets=DEFAULT_LINT_TARGETS) -> list[Path]:
+    out: list[Path] = []
+    for t in targets:
+        p = root / t
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.exists():
+            out.append(p)
+    return out
+
+
+def lint_file(path: Path, root: Path,
+              rules=None) -> list[Finding]:
+    rel = path.relative_to(root).as_posix()
+    source = path.read_text()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("syntax", rel, e.lineno or 0, f"syntax error: {e.msg}")]
+    sup = Suppressions(source, tree, rel)
+    findings = list(sup.findings)
+    selected = RULES if rules is None else {r: RULES[r] for r in rules}
+    for name, checker in selected.items():
+        for f in checker(rel, tree, source):
+            if not sup.allows(name, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def run_lint(root: Path, targets=DEFAULT_LINT_TARGETS,
+             rules=None) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in lint_paths(root, targets):
+        findings.extend(lint_file(path, root, rules))
+    return findings

@@ -1,0 +1,569 @@
+"""Canonical entry programs for the trace-contract auditors.
+
+Each registry entry reproduces one of the repo's real execution shapes —
+the same builders, the same operand construction, the same donation set
+the drivers use — at probe size, so the auditors in
+:mod:`etcd_tpu.analysis.audit` exercise the contracts on the programs
+that actually ship rather than on synthetic stand-ins:
+
+  bare_round       engine.build_round, the flagship lockstep step
+  metered_round    metrics.build_metered_round with telemetry + black box
+                   over the PR-8 storage diet (packed state, deferred
+                   emit, sparse outbox) — the observability pass shape
+  chaos_epoch      harness.build_chaos_epoch with every plane on (delay,
+                   crash, membership, telemetry, black box), donation per
+                   chaos.epoch_donate_argnums — the evidence-run shape
+  kv_round         engine.build_kv_round, the device-MVCC apply plane
+  sharded_round    parallel.build_sharded_round over the device mesh
+  shard_map_round  parallel.build_shard_map_round over the device mesh
+
+Probe sizes are deliberately tiny (C <= 64): every audited property —
+jaxpr/HLO structure, donation aliasing, collective ops — is a function
+of the traced program, not of the operand magnitudes, so the small
+shapes prove the same contracts the fleet-scale runs rely on.
+
+Every program carries >= 3 labelled runtime-operand VARIANTS (same
+pytree structure and avals, different values) for the one-trace audit:
+the lowered program must be bit-identical across them, the discipline
+that lets one traced epoch serve every fault mix.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+__all__ = ["ProgramInstance", "PROGRAM_BUILDERS", "PROGRAM_NAMES",
+           "get_program", "sharded_program", "round_value_variants",
+           "epoch_value_variants"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramInstance:
+    """One audited entry program: a jitted callable (donation baked in)
+    plus the operand sets and the declared donation contract."""
+
+    name: str
+    jitted: Any                       # jitted callable, donation applied
+    donate: tuple[int, ...]           # the declared donation set (audited)
+    C: int                            # fleet width; trailing-C leaves are
+    #                                   "fleet-scaled" for the completeness rule
+    base: tuple                       # base operand tuple
+    variants: tuple[tuple[str, tuple], ...]  # (label, args) value variants
+    expected_outputs: int             # top-level output arity (D2H bound)
+    # argnum -> why this fleet-scaled carry is deliberately NOT donated
+    undonated_ok: Mapping[int, str] = dataclasses.field(default_factory=dict)
+    # (donated argnum, live argnum) -> why a shared buffer between them
+    # is tolerated (the empty_crash_state alias class)
+    live_alias_ok: Mapping[tuple[int, int], str] = dataclasses.field(
+        default_factory=dict)
+    mesh: Any = None                  # device mesh => collectives-audited
+
+
+# ---------------------------------------------------------------------------
+# operand construction (mirrors __graft_entry__._fleet_inputs / run_chaos)
+# ---------------------------------------------------------------------------
+
+def _probe_spec():
+    from etcd_tpu.types import Spec
+
+    return Spec(M=3, L=16, E=1, K=2, W=2, R=2, A=2)
+
+
+def round_args(spec, cfg, C: int):
+    """The 9 round operands in the engine convention (clusters-minor),
+    honoring the cfg's storage forms — packed state under packed_state,
+    the compacted wire under compact_wire."""
+    import jax.numpy as jnp
+
+    from etcd_tpu.models.engine import empty_inbox, init_fleet
+
+    state = init_fleet(spec, C, election_tick=cfg.election_tick)
+    if cfg.packed_state:
+        from etcd_tpu.models.state import pack_fleet
+
+        state = pack_fleet(spec, state)
+    inbox = empty_inbox(
+        spec, C, wire_int16=cfg.wire_int16,
+        compact_bound=cfg.inbox_bound if cfg.compact_wire else 0,
+    )
+    M, E = spec.M, spec.E
+    prop_len = jnp.zeros((M, C), jnp.int32).at[0].set(1)
+    prop_data = jnp.zeros((M, E, C), jnp.int32).at[0, 0].set(7)
+    prop_type = jnp.zeros((M, E, C), jnp.int32)
+    ri_ctx = jnp.zeros((M, C), jnp.int32)
+    do_hup = jnp.zeros((M, C), jnp.bool_).at[0].set(True)
+    do_tick = jnp.ones((M, C), jnp.bool_)
+    keep_mask = jnp.ones((M, M, C), jnp.bool_)
+    return (state, inbox, prop_len, prop_data, prop_type, ri_ctx, do_hup,
+            do_tick, keep_mask)
+
+
+def round_value_variants(spec, C: int, base: tuple, offset: int = 2):
+    """>= 3 value-only variants of a round operand tuple (positions
+    `offset`.. are prop_len, prop_data, prop_type, ri_ctx, do_hup,
+    do_tick, keep_mask). Shared by the registry and driver preflight."""
+    import jax.numpy as jnp
+
+    M = spec.M
+    pre, ops = base[:offset], list(base[offset:])
+
+    def with_(i, v):
+        out = list(ops)
+        out[i] = v
+        return pre + tuple(out)
+
+    prop_len, prop_data = ops[0], ops[1]
+    shifted = pre + (
+        jnp.zeros_like(prop_len).at[M - 1].set(2),
+        jnp.zeros_like(prop_data).at[M - 1, 0].set(99),
+    ) + tuple(ops[2:])
+    quiet = with_(4, jnp.zeros_like(ops[4]))      # do_hup off
+    quiet = quiet[:offset + 5] + (jnp.zeros_like(ops[5]),) \
+        + quiet[offset + 6:]                      # do_tick off too
+    cut = with_(6, ops[6].at[0, 1].set(False))    # one link dropped
+    return (("prop-shift", shifted), ("quiet", quiet), ("link-cut", cut))
+
+
+# ---------------------------------------------------------------------------
+# the programs
+# ---------------------------------------------------------------------------
+
+def _bare_round() -> ProgramInstance:
+    import jax
+
+    from etcd_tpu.models.engine import build_round
+    from etcd_tpu.utils.config import RaftConfig
+
+    spec, C = _probe_spec(), 8
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=2)
+    args = round_args(spec, cfg, C)
+    return ProgramInstance(
+        name="bare_round",
+        jitted=jax.jit(build_round(cfg, spec), donate_argnums=(0, 1)),
+        donate=(0, 1),
+        C=C,
+        base=args,
+        variants=round_value_variants(spec, C, args),
+        expected_outputs=2,
+    )
+
+
+def _metered_round() -> ProgramInstance:
+    import dataclasses as _dc
+
+    import jax
+
+    from etcd_tpu.models.metrics import build_metered_round, zero_metrics
+    from etcd_tpu.models.telemetry import init_telemetry
+    from etcd_tpu.types import MSG_APP, MSG_APP_RESP, MSG_PROP
+    from etcd_tpu.utils.config import RaftConfig
+
+    # C=12 dodges aval collisions between probe-C-trailing leaves and
+    # small fixed-size planes (the 8-slot lag histogram would otherwise
+    # read as fleet-scaled at C=8)
+    spec, C = _probe_spec(), 12
+    # the bench steady-state storage diet (PR-8): packed fleet, deferred
+    # emit, sparse-outbox-eligible message classes — the observability
+    # pass must compose with the diet it meters
+    cfg = _dc.replace(
+        RaftConfig(pre_vote=True, check_quorum=True, max_inflight=2,
+                   coalesce_commit_refresh=True),
+        local_steps=("prop",),
+        message_classes=(MSG_APP, MSG_APP_RESP, MSG_PROP),
+        entry_classes=("normal",),
+        deferred_emit=True,
+        sparse_outbox=True,
+        packed_state=True,
+    )
+    args9 = round_args(spec, cfg, C)
+    from etcd_tpu.harness.chaos import empty_blackbox
+    from etcd_tpu.models.engine import init_fleet
+
+    dense = init_fleet(spec, C, election_tick=cfg.election_tick)
+    tele = init_telemetry(spec, dense)
+    bb = empty_blackbox(spec, dense).ring
+    args = args9 + (zero_metrics(), tele, bb)
+    variants = tuple(
+        (label, v + (zero_metrics(), tele, bb))
+        for label, v in round_value_variants(spec, C, args9)
+    )
+    fn = build_metered_round(cfg, spec, with_telemetry=True,
+                             with_blackbox=True)
+    # donation contract: the fleet carry (0, 1) plus the fleet-scaled
+    # observability carries — telemetry (10: birth ring [L, C], per-node
+    # lanes) and the event ring (11: [W, M, C]); both are exclusively
+    # threaded, the pre-call pytree is dead once the round returns.
+    # FleetMetrics (9) is a handful of scalars — donation is free to
+    # skip there.
+    donate = (0, 1, 10, 11)
+    return ProgramInstance(
+        name="metered_round",
+        jitted=jax.jit(fn, donate_argnums=donate),
+        donate=donate,
+        C=C,
+        base=args,
+        variants=variants,
+        expected_outputs=5,
+    )
+
+
+def epoch_value_variants(spec, base: tuple):
+    """>= 3 value-only variants of the chaos epoch operands (positions
+    10.. are drop_p, delay_p, partition_p, crash_p, down_rounds,
+    keep_log, config_aware, member_p, palette, snap_boost,
+    member_boost). Shared by the registry and chaos_run preflight."""
+    import jax.numpy as jnp
+
+    def with_(over: dict):
+        knobs = list(base[10:])
+        for i, v in over.items():
+            knobs[i - 10] = v
+        return base[:10] + tuple(knobs)
+
+    f32 = jnp.float32
+    crash_heavy = with_({13: f32(0.25), 14: jnp.int32(5), 19: f32(4.0)})
+    palette_roll = with_({17: f32(0.1), 18: jnp.roll(base[18], 1),
+                          20: f32(3.0)})
+    broken_models = with_({10: f32(0.0), 11: f32(0.0),
+                           15: jnp.bool_(False), 16: jnp.bool_(False)})
+    return (("crash-heavy", crash_heavy), ("palette-roll", palette_roll),
+            ("broken-models", broken_models))
+
+
+def _chaos_epoch() -> ProgramInstance:
+    import jax
+    import jax.numpy as jnp
+
+    from etcd_tpu.harness.chaos import (
+        build_chaos_epoch,
+        empty_blackbox,
+        empty_crash_state,
+        empty_held,
+        epoch_donate_argnums,
+        member_palette,
+        zero_violations,
+    )
+    from etcd_tpu.models.engine import empty_inbox, init_fleet
+    from etcd_tpu.models.telemetry import init_telemetry
+    from etcd_tpu.utils.config import RaftConfig
+
+    spec, C, rounds = _probe_spec(), 4, 2
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=2)
+    state = init_fleet(spec, C, election_tick=cfg.election_tick)
+    M, E = spec.M, spec.E
+    f32 = jnp.float32
+    args = (
+        state,
+        empty_inbox(spec, C, wire_int16=cfg.wire_int16),
+        empty_held(spec, C, cfg.wire_int16),
+        empty_crash_state(state),
+        jax.random.PRNGKey(0),
+        jnp.zeros((M, C), jnp.int32).at[0].set(1),
+        jnp.zeros((M, E, C), jnp.int32).at[0, 0].set(7),
+        zero_violations(),
+        init_telemetry(spec, state),
+        empty_blackbox(spec, state),
+        f32(0.02), f32(0.05), f32(0.1),            # drop / delay / partition
+        f32(0.05), jnp.int32(3),                   # crash_p / down_rounds
+        jnp.bool_(True), jnp.bool_(True),          # keep_log / config_aware
+        f32(0.02), member_palette(spec, "standard"),
+        f32(1.0), f32(1.0),                        # snap / member boosts
+    )
+    fn = build_chaos_epoch(
+        cfg, spec, rounds,
+        with_delay=True, with_crash=True, with_member=True,
+        with_telemetry=True, with_blackbox=True,
+    )
+    # audit the ACCELERATOR donation contract — epoch_donate_argnums
+    # returns () on cpu by design (see its docstring), which would make
+    # the audit vacuous on the CPU hosts that run it
+    donate = epoch_donate_argnums(True, True, True, "tpu")
+    return ProgramInstance(
+        name="chaos_epoch",
+        jitted=jax.jit(fn, donate_argnums=donate),
+        donate=donate,
+        C=C,
+        base=args,
+        variants=epoch_value_variants(spec, args),
+        expected_outputs=9,
+        undonated_ok={
+            3: "CrashState is a few [M, C] planes and rides as None on "
+               "the crash-free tiers — donating it risks the None-"
+               "donation hazard for marginal HBM (epoch_donate_argnums)",
+        },
+        live_alias_ok={
+            (0, 3): "empty_crash_state seeds stable=state.last_index and "
+                    "prev_term=state.term by reference; the TPU runtime "
+                    "tolerates the donated-live alias and the CPU path "
+                    "never donates (epoch_donate_argnums docstring)",
+        },
+    )
+
+
+def _kv_round() -> ProgramInstance:
+    import jax.numpy as jnp
+
+    from etcd_tpu.device_mvcc.state import KVSpec, init_kv
+    from etcd_tpu.models.engine import _jitted_kv_round
+    from etcd_tpu.utils.config import RaftConfig
+
+    spec, C = _probe_spec(), 8
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=2)
+    kvspec = KVSpec(keys=16)
+    args9 = round_args(spec, cfg, C)
+    kv = init_kv(kvspec, C)
+    on = jnp.ones((C,), jnp.bool_)
+    base = args9[:2] + (kv, on) + args9[2:]
+    variants = []
+    for label, v in round_value_variants(spec, C, args9):
+        variants.append((label, v[:2] + (kv, on) + v[2:]))
+    # do_apply is the canonical runtime-operand switch: one traced
+    # program serves device-apply AND host-apply modes
+    variants.append(("apply-off",
+                     args9[:2] + (kv, jnp.zeros((C,), jnp.bool_))
+                     + args9[2:]))
+    variants.append(("apply-mixed",
+                     args9[:2] + (kv, on.at[::2].set(False)) + args9[2:]))
+    carry_reason = (
+        "deliberately undonated: _jitted_kv_round serves interactive "
+        "hosts (DeviceBackedStore, the mvcc tests) that re-read the "
+        "pre-round kv/state for the do_apply=off identity contract — "
+        "donation would delete the buffers they compare against"
+    )
+    return ProgramInstance(
+        name="kv_round",
+        jitted=_jitted_kv_round(cfg, spec, kvspec, 0),
+        donate=(),
+        C=C,
+        base=base,
+        variants=tuple(variants),
+        expected_outputs=4,
+        undonated_ok={0: carry_reason, 1: carry_reason, 2: carry_reason},
+    )
+
+
+def _mesh_or_none():
+    import jax
+
+    from etcd_tpu.parallel.mesh import make_fleet_mesh
+
+    n = len(jax.devices())
+    n = 8 if n >= 8 else (4 if n >= 4 else (2 if n >= 2 else 1))
+    return make_fleet_mesh(n), n
+
+
+def sharded_program(name: str, use_shard_map: bool, spec=None, cfg=None,
+                    C: int = 64) -> ProgramInstance:
+    """Parameterized sharded-round instance. The registry entries use
+    the probe spec at C=64 (the test_mesh_equivalence geometry); the
+    test tier passes a smaller Spec because the post-SPMD compile the
+    collectives audit needs scales with program size (~2.5 min at the
+    probe spec on a CPU host, measured)."""
+    from etcd_tpu.parallel.mesh import (
+        build_shard_map_round,
+        build_sharded_round,
+        shard_fleet,
+    )
+    from etcd_tpu.utils.config import RaftConfig
+
+    spec = spec or _probe_spec()
+    cfg = cfg or RaftConfig(pre_vote=True, check_quorum=True, max_inflight=2)
+    mesh, _n = _mesh_or_none()
+    args = shard_fleet(mesh, *round_args(spec, cfg, C))
+    build = build_shard_map_round if use_shard_map else build_sharded_round
+    variants = tuple(
+        (label, shard_fleet(mesh, *v))
+        for label, v in round_value_variants(spec, C, tuple(args))
+    )
+    return ProgramInstance(
+        name=name,
+        jitted=build(cfg, spec, mesh),
+        donate=(0, 1),
+        C=C,
+        base=tuple(args),
+        variants=variants,
+        expected_outputs=2,
+        mesh=mesh,
+    )
+
+
+def _sharded_round() -> ProgramInstance:
+    return sharded_program("sharded_round", use_shard_map=False)
+
+
+def _shard_map_round() -> ProgramInstance:
+    return sharded_program("shard_map_round", use_shard_map=True)
+
+
+# ---------------------------------------------------------------------------
+# driver preflight factories (bench.py / chaos_run.py --preflight): the
+# exact program structure the driver's knobs select, at probe operand
+# shapes, with the driver's own donation sets
+# ---------------------------------------------------------------------------
+
+def bench_programs(cfg, steady_cfg, spec, telem: bool, bb_on: bool,
+                   buckets: int = 8,
+                   probe_C: int = 12) -> list[ProgramInstance]:
+    """The program shapes a bench run executes: the steady-state timed
+    scan (steady_cfg) plus, when observability is on, the met_step /
+    bb_step metered rounds with the driver's positional donation sets
+    (bench.py builds the same jits with the same donate_argnums)."""
+    import jax
+
+    from etcd_tpu.models.engine import init_fleet
+    from etcd_tpu.models.metrics import build_metered_round, zero_metrics
+    from etcd_tpu.parallel.mesh import build_scan_rounds
+
+    out = []
+    scan_args = round_args(spec, steady_cfg, probe_C)
+    out.append(ProgramInstance(
+        name="bench-steady-scan",
+        jitted=build_scan_rounds(steady_cfg, spec, None, rounds=2),
+        donate=(0, 1),
+        C=probe_C,
+        base=scan_args,
+        variants=round_value_variants(spec, probe_C, scan_args),
+        expected_outputs=2,
+    ))
+    if not (telem or bb_on):
+        return out
+
+    args9 = round_args(spec, cfg, probe_C)
+    dense = init_fleet(spec, probe_C, election_tick=cfg.election_tick)
+    from etcd_tpu.models.telemetry import init_telemetry
+
+    tele = init_telemetry(spec, dense, buckets=buckets) if telem else None
+
+    def metered(name, with_blackbox, tail, donate, expected):
+        return ProgramInstance(
+            name=name,
+            jitted=jax.jit(
+                build_metered_round(cfg, spec, with_telemetry=telem,
+                                    with_blackbox=with_blackbox),
+                donate_argnums=donate),
+            donate=donate,
+            C=probe_C,
+            base=args9 + tail,
+            variants=tuple(
+                (label, v + tail)
+                for label, v in round_value_variants(spec, probe_C, args9)
+            ),
+            expected_outputs=expected,
+        )
+
+    if telem:
+        out.append(metered("bench-metered-round", False,
+                           (zero_metrics(), tele), (0, 1, 10), 4))
+    if bb_on:
+        from etcd_tpu.models.blackbox import init_blackbox
+
+        bb = init_blackbox(spec, dense)
+        # without telemetry the tele slot rides positionally as None so
+        # the ring lands at the donated arg 11 (keyword args can't donate)
+        tail = (zero_metrics(), tele, bb)
+        donate = (0, 1, 10, 11) if telem else (0, 1, 11)
+        out.append(metered("bench-blackbox-round", True, tail, donate,
+                           4 + int(telem)))
+    return out
+
+
+def chaos_epoch_program(cfg, spec, *, with_delay: bool = True,
+                        with_crash: bool = False, with_member: bool = False,
+                        with_telemetry: bool = True,
+                        with_blackbox: bool = False,
+                        blackbox_window: int = 32, buckets: int = 8,
+                        probe_C: int = 4, rounds: int = 2) -> ProgramInstance:
+    """The epoch program a chaos_run invocation will execute (same
+    structure flags, probe C / rounds), with the ACCELERATOR donation
+    contract from chaos.epoch_donate_argnums."""
+    import jax
+    import jax.numpy as jnp
+
+    from etcd_tpu.harness.chaos import (
+        build_chaos_epoch,
+        empty_blackbox,
+        empty_crash_state,
+        empty_held,
+        epoch_donate_argnums,
+        member_palette,
+        zero_violations,
+    )
+    from etcd_tpu.models.engine import empty_inbox, init_fleet
+    from etcd_tpu.models.telemetry import init_telemetry
+
+    C = probe_C
+    state = init_fleet(spec, C, election_tick=cfg.election_tick)
+    has_crash_carry = with_crash or with_member
+    M, E = spec.M, spec.E
+    f32 = jnp.float32
+    args = (
+        state,
+        empty_inbox(spec, C, wire_int16=cfg.wire_int16),
+        empty_held(spec, C, cfg.wire_int16) if with_delay else None,
+        empty_crash_state(state) if has_crash_carry else None,
+        jax.random.PRNGKey(0),
+        jnp.zeros((M, C), jnp.int32).at[0].set(1),
+        jnp.zeros((M, E, C), jnp.int32).at[0, 0].set(7),
+        zero_violations(),
+        init_telemetry(spec, state, buckets=buckets)
+        if with_telemetry else None,
+        empty_blackbox(spec, state, window=blackbox_window)
+        if with_blackbox else None,
+        f32(0.02), f32(0.05 if with_delay else 0.0), f32(0.1),
+        f32(0.05 if has_crash_carry else 0.0),
+        jnp.int32(3 if with_crash else 1),
+        jnp.bool_(True), jnp.bool_(True),
+        f32(0.02 if with_member else 0.0),
+        # run_chaos passes a 1-slot zero palette when membership chaos
+        # is structurally off (the operand must still exist)
+        member_palette(spec, "standard") if with_member
+        else jnp.zeros((1,), jnp.int32),
+        f32(1.0), f32(1.0),
+    )
+    fn = build_chaos_epoch(
+        cfg, spec, rounds,
+        with_delay=with_delay, with_crash=with_crash,
+        with_member=with_member, with_telemetry=with_telemetry,
+        with_blackbox=with_blackbox,
+    )
+    donate = epoch_donate_argnums(with_delay, with_telemetry, with_blackbox,
+                                  "tpu")
+    undonated_ok = {}
+    live_alias_ok = {}
+    if has_crash_carry:
+        undonated_ok[3] = (
+            "CrashState is a few [M, C] planes and rides as None on the "
+            "crash-free tiers — donating it risks the None-donation "
+            "hazard for marginal HBM (epoch_donate_argnums)")
+        live_alias_ok[(0, 3)] = (
+            "empty_crash_state seeds stable/prev_term as references to "
+            "state leaves; TPU tolerates the donated-live alias and the "
+            "CPU path never donates (epoch_donate_argnums docstring)")
+    return ProgramInstance(
+        name="chaos-epoch",
+        jitted=jax.jit(fn, donate_argnums=donate),
+        donate=donate,
+        C=C,
+        base=args,
+        variants=epoch_value_variants(spec, args),
+        expected_outputs=9,
+        undonated_ok=undonated_ok,
+        live_alias_ok=live_alias_ok,
+    )
+
+
+# cheap -> expensive (the chaos epoch trace dominates; keep it last so a
+# fast-failing run reports the light programs first)
+PROGRAM_BUILDERS: dict[str, Callable[[], ProgramInstance]] = {
+    "bare_round": _bare_round,
+    "kv_round": _kv_round,
+    "metered_round": _metered_round,
+    "sharded_round": _sharded_round,
+    "shard_map_round": _shard_map_round,
+    "chaos_epoch": _chaos_epoch,
+}
+PROGRAM_NAMES = tuple(PROGRAM_BUILDERS)
+
+
+def get_program(name: str) -> ProgramInstance:
+    return PROGRAM_BUILDERS[name]()

@@ -1010,15 +1010,15 @@ def build_chaos_epoch(
     return epoch
 
 
-@functools.lru_cache(maxsize=32)
-def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
-                   faultless: bool, with_delay: bool = True,
-                   with_crash: bool = False, with_member: bool = False,
-                   with_telemetry: bool = False,
-                   with_blackbox: bool = False):
-    """One jitted epoch program per (cfg, spec, rounds, structure),
-    shared across every run_chaos call and fault mix (probabilities are
-    operands). Donation of the fleet-sized carries (state/inbox/held) is
+def epoch_donate_argnums(with_delay: bool, with_telemetry: bool,
+                         with_blackbox: bool, backend: str) -> tuple[int, ...]:
+    """The epoch program's donation set, as a pure function of the
+    program structure and backend — the single source of truth shared by
+    ``_epoch_program`` and the donation auditor
+    (etcd_tpu/analysis/audit.py), so the audited contract can never
+    drift from the executed one.
+
+    Donation of the fleet-sized carries (state/inbox/held) is
     accelerator-only: large-C runs that compile fine otherwise die at
     runtime allocation from double-buffering, while host runs don't need
     the memory and keep maximum runtime portability. Donating on CPU was
@@ -1031,29 +1031,44 @@ def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
     TPU runtime tolerates the alias (the 262k–1M chaos evidence runs all
     donated); donation safety for external callers is covered by
     tests/test_donation.py against the engine/mesh builders."""
-    if jax.default_backend() != "cpu":
-        # held (arg 2) is None (no buffers) when the delay machinery is
-        # compiled out — donating it is at best a no-op and has crashed
-        # the tunneled TPU worker at fleet scale. CrashState (arg 3) is
-        # a few [M, C] planes — not worth the same None-donation hazard.
-        donate = (0, 1, 2) if with_delay else (0, 1)
-        if with_telemetry:
-            # the telemetry carry (arg 8) holds fleet-scaled leaves
-            # (birth_ring [L, C], cand_since/heal_since [M, C]) and is
-            # exclusively threaded — the pre-call pytree is dead once
-            # the epoch returns (flight_record reads the returned one),
-            # so it joins the anti-double-buffering list. Only when the
-            # plane is on: tele=None is the same None-donation hazard
-            # as held.
-            donate = donate + (8,)
-        if with_blackbox:
-            # same story for the black-box carry (arg 9): the ring leaf
-            # is [W, M, C] — fleet-scaled — and exclusively threaded;
-            # gate on the plane being on to avoid the None-donation
-            # hazard above.
-            donate = donate + (9,)
-    else:
-        donate = ()
+    if backend == "cpu":
+        return ()
+    # held (arg 2) is None (no buffers) when the delay machinery is
+    # compiled out — donating it is at best a no-op and has crashed
+    # the tunneled TPU worker at fleet scale. CrashState (arg 3) is
+    # a few [M, C] planes — not worth the same None-donation hazard.
+    donate = (0, 1, 2) if with_delay else (0, 1)
+    if with_telemetry:
+        # the telemetry carry (arg 8) holds fleet-scaled leaves
+        # (birth_ring [L, C], cand_since/heal_since [M, C]) and is
+        # exclusively threaded — the pre-call pytree is dead once
+        # the epoch returns (flight_record reads the returned one),
+        # so it joins the anti-double-buffering list. Only when the
+        # plane is on: tele=None is the same None-donation hazard
+        # as held.
+        donate = donate + (8,)
+    if with_blackbox:
+        # same story for the black-box carry (arg 9): the ring leaf
+        # is [W, M, C] — fleet-scaled — and exclusively threaded;
+        # gate on the plane being on to avoid the None-donation
+        # hazard above.
+        donate = donate + (9,)
+    return donate
+
+
+@functools.lru_cache(maxsize=32)
+def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
+                   faultless: bool, with_delay: bool = True,
+                   with_crash: bool = False, with_member: bool = False,
+                   with_telemetry: bool = False,
+                   with_blackbox: bool = False):
+    """One jitted epoch program per (cfg, spec, rounds, structure),
+    shared across every run_chaos call and fault mix (probabilities are
+    operands). The donation set is epoch_donate_argnums — see its
+    docstring for the accelerator-only rationale and the CrashState
+    alias hazard."""
+    donate = epoch_donate_argnums(with_delay, with_telemetry,
+                                  with_blackbox, jax.default_backend())
     return jax.jit(
         build_chaos_epoch(cfg, spec, rounds, faultless=faultless,
                           with_delay=with_delay, with_crash=with_crash,
@@ -1064,6 +1079,7 @@ def _epoch_program(cfg: RaftConfig, spec: Spec, rounds: int,
     )
 
 
+# lint: allow-def(host-sync) -- the host driver: epoch orchestration + report path, outside the traced epoch
 def run_chaos(
     spec: Spec,
     cfg: RaftConfig,

@@ -250,6 +250,7 @@ def first_k_offenders(mask: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.sort(idx)[:k]
 
 
+# lint: allow-def(host-sync) -- the documented narrow transfer: first-K offender lanes only
 def gather_forensics(ring: EventRing, viol_groups: jnp.ndarray,
                      viol_round: jnp.ndarray, k: int) -> dict:
     """Reduce + gather on device, then ONE narrow host transfer: the
@@ -361,6 +362,7 @@ def violation_names(bits: int) -> list:
             if (int(bits) >> i) & 1]
 
 
+# lint: allow-def(host-sync) -- host-side post-mortem decode of the gathered lanes
 def forensics_report(ring: EventRing, viol_groups: jnp.ndarray,
                      viol_round: jnp.ndarray, k: int = 4) -> dict:
     """The chaos post-mortem: device-reduce to the first-K offending
@@ -393,6 +395,7 @@ def forensics_report(ring: EventRing, viol_groups: jnp.ndarray,
     }
 
 
+# lint: allow-def(host-sync) -- host-side serving-path decode; gathers only requested lanes
 def ring_capture(ring: EventRing, group_ids) -> list:
     """Decode live (non-violation) ring lanes for the given groups — the
     serving path's view for to_chrome_trace. Gathers only the requested

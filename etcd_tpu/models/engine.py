@@ -802,5 +802,7 @@ class RaftEngine:
         )
         return self.state
 
+    # lint: allow-def(host-sync) -- host probe on the eager facade, not in the round program
+
     def pending_messages(self) -> int:
         return int((self.inbox.type != 0).sum())

@@ -25,6 +25,9 @@ Differences from the reference (deliberate):
     config switch, and `last_conf_states` reports switches for drivers
     that want the reference's return value.
 """
+# lint: allow-module(host-sync) -- RawNode is the synchronous per-group host
+# adapter by contract (jitted at batch=1, driven step-by-step); every Ready()
+# harvest is a deliberate host round-trip, not a traced-round regression.
 from __future__ import annotations
 
 import dataclasses

@@ -21,7 +21,6 @@ Registered sites (kvserver/backend analogs of the reference markers):
 """
 from __future__ import annotations
 
-import os
 import threading
 
 
@@ -48,7 +47,9 @@ KNOWN = (
 
 
 def _load_env() -> None:
-    spec = os.environ.get("ETCD_TPU_FAILPOINTS", "")
+    from etcd_tpu.utils.knobs import env_str
+
+    spec = env_str("failpoints", "ETCD_TPU_FAILPOINTS", "")
     for part in spec.split(";"):
         part = part.strip()
         if not part or "=" not in part:

@@ -66,3 +66,23 @@ def env_str(prog: str, name: str, default: str,
     if choices is not None and raw not in choices:
         knob_error(prog, f"{name}={raw!r} is not one of {'/'.join(choices)}")
     return raw
+
+
+def env_list(prog: str, name: str, default: str,
+             choices: tuple[str, ...]) -> tuple[str, ...]:
+    """Comma-separated selection knob with the same exit-2 contract.
+    "all" (the usual default) expands to every choice; any element
+    outside ``choices`` exits 2 (a typo'd ANALYSIS_RULES=hostsync must
+    not silently run zero rules). Order and duplicates are normalized to
+    the declaration order of ``choices``."""
+    raw = os.environ.get(name, default)
+    if raw == "all":
+        return tuple(choices)
+    parts = tuple(p.strip() for p in raw.split(",") if p.strip())
+    if not parts:
+        knob_error(prog, f"{name}={raw!r} selects nothing")
+    for p in parts:
+        if p not in choices:
+            knob_error(prog,
+                       f"{name}: {p!r} is not one of {'/'.join(choices)}")
+    return tuple(c for c in choices if c in parts)

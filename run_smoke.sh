@@ -31,7 +31,18 @@
 # along: black-box ring bit-identity over the same round programs, the
 # numpy word-replay cross-check, the persist-nothing post-mortem at
 # C=16, and the host Trace unit tests — all small-C, no slow marks.
+#
+# The static-analysis fast tier runs FIRST: source lint + the widths
+# table cross-check (etcd_tpu/analysis — milliseconds, no tracing).
+# A lint finding here is a real defect or an unjustified suppression;
+# fix it before burning pytest time. The trace/HLO auditors
+# (ANALYSIS_AUDIT=1, the default CLI mode) stay out of the smoke loop —
+# they re-trace every registry program (minutes); run the full CLI
+# before a commit milestone instead.
 cd "$(dirname "$0")"
+ANALYSIS_AUDIT=0 python -m etcd_tpu.analysis || exit 1
+JAX_PLATFORMS=cpu ANALYSIS_LINT=0 ANALYSIS_AUDITORS=widths \
+  ANALYSIS_PROGRAMS=bare_round python -m etcd_tpu.analysis || exit 1
 exec python -m pytest -q -m 'not slow' \
   tests/test_datadriven_quorum.py \
   tests/test_datadriven_confchange.py \

@@ -1,0 +1,509 @@
+"""Static-analysis plane tests (etcd_tpu/analysis — ISSUE 19).
+
+Three tiers:
+
+  * lint-rule unit tests over seeded source fixtures (tmp files), plus
+    the repo-clean gate: the real tree lints to zero findings;
+  * auditor seeded-violation tests over toy jitted programs — every
+    auditor must FIRE on its violation class (reintroduced PR-9-style
+    double-donation, jaxpr divergence on an operand change, a host
+    callback in the round body, a cross-shard psum) and stay quiet on
+    the clean form;
+  * acceptance: the real chaos epoch holds the one-trace contract
+    across >= 3 runtime-operand variants, the real sharded round
+    compiles to zero cross-shard collectives, and the CLI's exit-code
+    contract (0 clean / 1 findings / 2 bad knob) subprocess-checks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from etcd_tpu.analysis import audit as A
+from etcd_tpu.analysis import lint as L
+from etcd_tpu.analysis.programs import (
+    ProgramInstance,
+    get_program,
+    sharded_program,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# lint rules over seeded fixtures
+# ---------------------------------------------------------------------------
+
+def _lint(tmp_path: Path, rel: str, src: str, rules=None):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return L.lint_file(p, tmp_path, rules)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_env_knob_fires_on_raw_reads(tmp_path):
+    finds = _lint(tmp_path, "etcd_tpu/x.py", """\
+        import os
+        a = os.environ["MY_KNOB"]
+        b = os.environ.get("OTHER_KNOB", "1")
+        c = os.getenv("THIRD_KNOB")
+        """, rules=("env-knob",))
+    assert len(finds) == 3 and _rules(finds) == ["env-knob"]
+    assert all("utils.knobs" in f.message for f in finds)
+
+
+def test_env_knob_allowlist_and_presence_checks_legal(tmp_path):
+    finds = _lint(tmp_path, "etcd_tpu/x.py", """\
+        import os
+        p = os.environ.get("JAX_PLATFORMS")
+        f = os.environ["XLA_FLAGS"]
+        present = "MY_KNOB" in os.environ
+        child = dict(os.environ, MY_KNOB="1")
+        os.environ["MY_KNOB"] = "1"
+        """, rules=("env-knob",))
+    assert finds == []
+
+
+def test_host_sync_fires_only_in_traced_modules(tmp_path):
+    src = """\
+        import numpy as np
+        def f(x):
+            n = x.sum().item()
+            a = np.asarray(x)
+            return int(x.max())
+        """
+    inside = _lint(tmp_path, "etcd_tpu/models/x.py", src,
+                   rules=("host-sync",))
+    outside = _lint(tmp_path, "etcd_tpu/server/x.py", src,
+                    rules=("host-sync",))
+    assert len(inside) == 3 and _rules(inside) == ["host-sync"]
+    assert outside == []
+
+
+def test_debug_print_fires(tmp_path):
+    finds = _lint(tmp_path, "etcd_tpu/x.py", """\
+        import jax
+        def f(x):
+            jax.debug.print("x = {}", x)
+            breakpoint()
+            return x
+        """, rules=("debug-print",))
+    assert len(finds) == 2 and _rules(finds) == ["debug-print"]
+
+
+def test_undefined_name_fires_on_dangling_name(tmp_path):
+    # the PR-9 `margs` class: live only under a gated branch, bound
+    # nowhere — a NameError waiting for the right env
+    finds = _lint(tmp_path, "etcd_tpu/x.py", """\
+        import os
+        def f(flag):
+            if flag:
+                return margs
+            return 0
+        """, rules=("undefined-name",))
+    assert [f.rule for f in finds] == ["undefined-name"]
+    assert "margs" in finds[0].message
+
+
+def test_undefined_name_resolves_forward_refs(tmp_path):
+    finds = _lint(tmp_path, "etcd_tpu/x.py", """\
+        def f():
+            return helper() + later
+        def helper():
+            return 1
+        later = 2
+        """, rules=("undefined-name",))
+    assert finds == []
+
+
+def test_dead_knob_fires_for_undocumented_and_unused(tmp_path):
+    finds = _lint(tmp_path, "bench.py", '''\
+        """Docstring mentions BENCH_GOOD only."""
+        from etcd_tpu.utils.knobs import env_int
+        good = env_int("bench", "BENCH_GOOD", "1")
+        dead = env_int("bench", "BENCH_MYSTERY", "1")
+        print(good)
+        ''', rules=("dead-knob",))
+    msgs = [f.message for f in finds]
+    assert any("BENCH_MYSTERY" in m and "not documented" in m for m in msgs)
+    assert any("never used" in m for m in msgs)
+
+
+def test_suppression_with_reason_suppresses(tmp_path):
+    finds = _lint(tmp_path, "etcd_tpu/x.py", """\
+        import os
+        a = os.environ["K"]  # lint: allow(env-knob) -- fixture reason
+        """, rules=("env-knob",))
+    assert finds == []
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    finds = _lint(tmp_path, "etcd_tpu/x.py", """\
+        import os
+        a = os.environ["K"]  # lint: allow(env-knob)
+        """, rules=("env-knob",))
+    # the unjustified suppression is itself a finding AND does not
+    # suppress — both rules fire
+    assert _rules(finds) == ["env-knob", "suppression"]
+    assert any("justification" in f.message for f in finds)
+
+
+def test_suppression_allow_def_covers_whole_body(tmp_path):
+    finds = _lint(tmp_path, "etcd_tpu/x.py", """\
+        import os
+        # lint: allow-def(env-knob) -- fixture: host edge
+        def f():
+            a = os.environ["K1"]
+            return os.environ["K2"]
+        b = os.environ["K3"]
+        """, rules=("env-knob",))
+    assert len(finds) == 1 and "K3" in finds[0].message
+
+
+def test_repo_lints_clean():
+    """The gate the CLI enforces: the current tree carries zero lint
+    findings (every host edge / platform read is either restructured or
+    justified at the use site)."""
+    findings = L.run_lint(REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# widths auditor (pure table cross-check; no tracing)
+# ---------------------------------------------------------------------------
+
+def test_widths_clean_on_real_tables():
+    assert A.audit_widths() == []
+
+
+def test_widths_seeded_violations_fire():
+    from etcd_tpu.models import state as st
+    from etcd_tpu.types import MSG_SNAP
+
+    # a field dropped from the durability partition breaks coverage
+    durable = tuple(f for f in st.DURABLE_FIELDS if f != "term")
+    finds = A.audit_widths(durable=durable)
+    assert any("term" in f.message for f in finds), finds
+
+    # a field in two classes breaks disjointness
+    finds = A.audit_widths(
+        capped=tuple(st.CAPPED_FIELDS) + (st.DURABLE_FIELDS[0],))
+    assert any("disjoint" in f.message or "classes" in f.message
+               for f in finds), finds
+
+    # wide-row drift: an expected wide field the pack plan doesn't have
+    finds = A.audit_widths(
+        wide_expected=("applied_hash", "snap_hash", "log_data",
+                       "not_a_field"))
+    assert finds, "expected a wide-set mismatch finding"
+
+    # wire-split registry naming a field Msg doesn't carry
+    finds = A.audit_widths(wire_split={("bogus_field", MSG_SNAP)})
+    assert any("bogus_field" in f.message for f in finds), finds
+
+
+# ---------------------------------------------------------------------------
+# auditor seeded violations over toy programs
+# ---------------------------------------------------------------------------
+
+def _toy(fn, donate, base, variants=(), expected=1, **kw):
+    return ProgramInstance(
+        name="toy", jitted=jax.jit(fn, donate_argnums=donate),
+        donate=donate, C=4, base=tuple(base), variants=tuple(variants),
+        expected_outputs=expected, **kw)
+
+
+def test_donation_double_donation_fires():
+    """The PR-9 crash class, reintroduced: one buffer at two donated
+    positions aliases two live results into one allocation."""
+    x = jnp.zeros((4,), jnp.float32)
+
+    def fn(a, b):
+        return a + 1, b * 2
+
+    tp = A.TracedProgram(_toy(fn, (0, 1), (x, x), expected=2))
+    finds = A.audit_donation(tp)
+    assert any("donated positions" in f.message for f in finds), finds
+
+
+def test_donation_completeness_fires_and_justification_clears():
+    x = jnp.zeros((4,), jnp.float32)
+    y = jnp.ones((4,), jnp.float32)
+
+    def fn(a, b):
+        return a + 1, b * 2
+
+    inst = _toy(fn, (0,), (x, y), expected=2)
+    finds = A.audit_donation(A.TracedProgram(inst))
+    assert any("not donated" in f.message for f in finds), finds
+
+    ok = dataclasses.replace(
+        inst, undonated_ok={1: "fixture: caller re-reads the buffer"})
+    assert A.audit_donation(A.TracedProgram(ok)) == []
+
+
+def test_donation_not_carried_and_alias_validity_fire():
+    x = jnp.zeros((4,), jnp.float32)
+
+    def fn(a):
+        return a.sum()
+
+    finds = A.audit_donation(A.TracedProgram(_toy(fn, (0,), (x,))))
+    assert any("can never alias" in f.message for f in finds), finds
+    assert any("no remaining output slot" in f.message for f in finds), finds
+
+
+def test_donation_live_alias_fires_and_allowlist_clears():
+    x = jnp.zeros((4,), jnp.float32)
+
+    def fn(a, b):
+        return a + 1, b.sum()
+
+    inst = _toy(fn, (0,), (x, x), expected=2)
+    finds = A.audit_donation(A.TracedProgram(inst))
+    assert any("shares a buffer with live arg" in f.message
+               for f in finds), finds
+
+    # arg 1 also reads as a carried fleet-scaled arg (its aval matches
+    # the a+1 output), so the clean form needs both justifications
+    ok = dataclasses.replace(
+        inst, live_alias_ok={(0, 1): "fixture: backend tolerates it"},
+        undonated_ok={1: "fixture: caller re-reads the buffer"})
+    assert A.audit_donation(A.TracedProgram(ok)) == []
+
+
+def test_one_trace_clean_on_value_variants():
+    x = jnp.zeros((4,), jnp.float32)
+
+    def fn(a, k):
+        return a * k
+
+    inst = _toy(fn, (), (x, jnp.float32(2.0)),
+                variants=[(f"k{v}", (x, jnp.float32(v)))
+                          for v in (3.0, 4.0, 5.0)])
+    assert A.audit_one_trace(A.TracedProgram(inst)) == []
+
+
+def test_one_trace_divergence_on_operand_change_fires():
+    """Seeded jaxpr divergence: a variant whose operand change leaks
+    into the trace (here a shape change standing in for any retrace)
+    must fire — the one-trace contract is bit-identity."""
+    x = jnp.zeros((4,), jnp.float32)
+
+    def fn(a, k):
+        return a * k
+
+    inst = _toy(fn, (), (x, jnp.float32(2.0)),
+                variants=[("k3", (x, jnp.float32(3.0))),
+                          ("leak", (x, jnp.full((4,), 4.0, jnp.float32)))])
+    finds = A.audit_one_trace(A.TracedProgram(inst))
+    assert any(f.rule == "audit-one-trace" and "leak" in f.message
+               for f in finds), finds
+
+
+def test_one_trace_requires_three_operand_sets():
+    x = jnp.zeros((4,), jnp.float32)
+    inst = _toy(lambda a: a + 1, (), (x,), variants=[("only", (x,))])
+    finds = A.audit_one_trace(A.TracedProgram(inst))
+    assert any("fewer than 3 operand sets" in f.message for f in finds)
+
+
+def test_transfers_host_callback_fires():
+    x = jnp.zeros((4,), jnp.float32)
+
+    def fn(a):
+        jax.debug.print("a0 = {}", a[0])
+        return a + 1
+
+    finds = A.audit_transfers(A.TracedProgram(_toy(fn, (), (x,))))
+    assert any("host primitive" in f.message for f in finds), finds
+
+
+def test_transfers_output_arity_bound_fires():
+    x = jnp.zeros((4,), jnp.float32)
+
+    def fn(a):
+        return a + 1, a * 2  # one more result than declared
+
+    finds = A.audit_transfers(A.TracedProgram(_toy(fn, (), (x,),
+                                                   expected=1)))
+    assert any("declared bound" in f.message for f in finds), finds
+
+
+def test_collectives_toy_psum_fires():
+    """A shard_map psum over the fleet axis IS cross-shard traffic; the
+    auditor must see the all-reduce in the post-SPMD HLO."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = Mesh(devs[:2], ("c",))
+    fn = shard_map(lambda a: jax.lax.psum(a, "c"), mesh=mesh,
+                   in_specs=P("c"), out_specs=P())
+    x = jnp.arange(8, dtype=jnp.float32)
+    inst = dataclasses.replace(_toy(jax.jit(fn), (), (x,)), mesh=mesh)
+    # ProgramInstance.jitted must be the jitted fn itself
+    finds = A.audit_collectives(A.TracedProgram(inst))
+    assert any("all-reduce" in f.message for f in finds), finds
+
+
+def test_collectives_skips_unsharded_programs():
+    x = jnp.zeros((4,), jnp.float32)
+    assert A.audit_collectives(
+        A.TracedProgram(_toy(lambda a: a + 1, (), (x,)))) == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the real programs hold their contracts
+# ---------------------------------------------------------------------------
+
+def test_bare_round_full_audit_clean():
+    tp = A.TracedProgram(get_program("bare_round"))
+    finds = (A.audit_donation(tp) + A.audit_one_trace(tp)
+             + A.audit_transfers(tp))
+    assert finds == [], "\n".join(str(f) for f in finds)
+
+
+def test_bare_round_seeded_internal_alias_fires():
+    """Reintroduce the PR-9 bug shape on the REAL round program: two
+    leaves of the donated state carry sharing one buffer."""
+    prog = get_program("bare_round")
+    state = prog.base[0]
+    seeded = dataclasses.replace(
+        prog, base=(state.replace(commit=state.term),) + prog.base[1:])
+    finds = A.audit_donation(A.TracedProgram(seeded))
+    assert any("donated positions" in f.message for f in finds), finds
+
+
+def test_chaos_epoch_one_trace_across_variants():
+    """THE one-trace acceptance gate: the full chaos epoch (delay +
+    crash + membership + telemetry + blackbox) lowers bit-identically
+    across the base operand set and >= 3 runtime-value variants
+    (crash-heavy, palette-roll, broken-models)."""
+    prog = get_program("chaos_epoch")
+    assert len(prog.variants) >= 3
+    tp = A.TracedProgram(prog)
+    finds = A.audit_one_trace(tp) + A.audit_donation(tp)
+    assert finds == [], "\n".join(str(f) for f in finds)
+
+
+def test_sharded_round_zero_cross_shard_collectives():
+    """THE collectives acceptance gate: the steady-state sharded round
+    compiles (post-SPMD) to zero cross-shard collectives — clusters are
+    independent, so any collective is sharding-rule drift
+    (MULTICHIP_SCALING_r05, machine-checked). Runs at a reduced Spec so
+    the XLA optimization pass fits the test budget; the CLI audits the
+    full bench geometry."""
+    from etcd_tpu.types import Spec
+    from etcd_tpu.utils.config import RaftConfig
+
+    spec = Spec(M=3, L=4, E=1, K=1, W=1, R=1, A=1)
+    cfg = RaftConfig(pre_vote=True, check_quorum=True, max_inflight=1)
+    prog = sharded_program("small_sharded", False, spec=spec, cfg=cfg,
+                           C=16)
+    finds = A.audit_collectives(A.TracedProgram(prog))
+    assert finds == [], "\n".join(str(f) for f in finds)
+
+
+@pytest.mark.slow
+def test_registry_sharded_rounds_full_geometry_clean():
+    """Full bench-geometry sharded + shard_map rounds: zero cross-shard
+    collectives. Minutes of XLA compile cold; rides the persistent
+    compile cache when warm (tests/conftest.py)."""
+    for name in ("sharded_round", "shard_map_round"):
+        tp = A.TracedProgram(get_program(name))
+        finds = A.audit_collectives(tp)
+        assert finds == [], "\n".join(str(f) for f in finds)
+
+
+# ---------------------------------------------------------------------------
+# CLI + driver preflight exit-code contracts (subprocess)
+# ---------------------------------------------------------------------------
+
+def _run_cli(env_over, args=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_over)
+    return subprocess.run(
+        [sys.executable, "-m", "etcd_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+
+
+def test_cli_bad_knob_exits_2():
+    out = _run_cli({"ANALYSIS_RULES": "not-a-rule"})
+    assert out.returncode == 2, (out.returncode, out.stderr)
+    assert "ANALYSIS_RULES" in out.stderr
+
+
+def test_cli_rejects_arguments():
+    out = _run_cli({}, args=("--flag",))
+    assert out.returncode == 2, (out.returncode, out.stderr)
+
+
+def test_cli_lint_tier_clean_exits_0():
+    out = _run_cli({"ANALYSIS_AUDIT": "0"})
+    assert out.returncode == 0, (out.returncode, out.stdout, out.stderr)
+    assert not out.stdout.strip()
+    assert "0 finding(s)" in out.stderr
+
+
+def test_cli_seeded_violation_exits_1(tmp_path):
+    # ANALYSIS_PATHS targets must live under the repo root; park the
+    # fixture there and remove it after
+    seeded = REPO / "_analysis_seed_fixture_tmp.py"
+    seeded.write_text('import os\nx = os.environ["SEEDED_KNOB"]\n')
+    try:
+        out = _run_cli({"ANALYSIS_AUDIT": "0",
+                        "ANALYSIS_PATHS": seeded.name})
+        assert out.returncode == 1, (out.returncode, out.stdout, out.stderr)
+        assert "SEEDED_KNOB" in out.stdout and "env-knob" in out.stdout
+    finally:
+        seeded.unlink()
+
+
+def test_cli_missing_path_exits_2():
+    out = _run_cli({"ANALYSIS_PATHS": "no/such/file.py"})
+    assert out.returncode == 2, (out.returncode, out.stderr)
+
+
+def test_cli_widths_audit_tier_exits_0():
+    # the cheapest audit tier: no program tracing, just the table
+    # cross-check — run_smoke.sh's analysis step uses this shape
+    out = _run_cli({"ANALYSIS_LINT": "0", "ANALYSIS_AUDITORS": "widths",
+                    "ANALYSIS_PROGRAMS": "bare_round"})
+    assert out.returncode == 0, (out.returncode, out.stdout, out.stderr)
+
+
+def test_drivers_reject_unknown_arguments():
+    for script in ("bench.py", "chaos_run.py"):
+        out = subprocess.run(
+            [sys.executable, str(REPO / script), "--not-a-flag"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=120)
+        assert out.returncode == 2, (script, out.returncode, out.stderr)
+        assert "--preflight" in out.stderr
+
+
+@pytest.mark.slow
+def test_chaos_run_preflight_passes():
+    """chaos_run --preflight audits the exact epoch program the knobs
+    select and exits through the normal run (clean contract)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", CHAOS_C="64",
+               CHAOS_ROUNDS="2", CHAOS_LEASE="0")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "chaos_run.py"), "--preflight"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=580)
+    assert out.returncode == 0, (out.returncode, out.stderr[-800:])
+    assert "# preflight ok" in out.stderr

@@ -15,7 +15,6 @@ lost-commit round while only the offending groups' rings cross PCIe.
 import dataclasses
 import json
 import os
-import re
 import subprocess
 import sys
 
@@ -536,16 +535,18 @@ def test_forensics_knob_validation_exits_2(script, env_extra, needle):
 
 def test_drivers_read_env_through_knob_helpers_only():
     """Knob hygiene: the scale drivers route every env knob through
-    utils/knobs (one validation idiom, one exit-2 contract). Raw
-    os.environ VALUE reads are banned outside the allowlist; presence
-    checks (`"X" in os.environ`) and child-env construction
-    (`dict(os.environ, ...)`) are fine and don't match."""
-    allow = {"JAX_PLATFORMS"}
-    pat = re.compile(r'os\.environ(?:\.get\(|\[)\s*"(\w+)"')
-    for script in ("bench.py", "chaos_run.py"):
-        with open(os.path.join(REPO, script)) as fh:
-            src = fh.read()
-        bad = sorted({m.group(1) for m in pat.finditer(src)} - allow)
-        assert not bad, (
-            f"{script} reads {bad} straight off os.environ; "
-            "route new knobs through etcd_tpu.utils.knobs")
+    utils/knobs (one validation idiom, one exit-2 contract). The check
+    itself moved into the static-analysis plane (the ``env-knob`` rule,
+    etcd_tpu/analysis/lint.py, AST-based so presence checks and
+    child-env construction stay legal); this wrapper keeps the PR-10
+    contract pinned to the two drivers from the telemetry suite that
+    introduced it."""
+    from pathlib import Path
+
+    from etcd_tpu.analysis.lint import run_lint
+
+    findings = run_lint(Path(REPO), targets=("bench.py", "chaos_run.py"),
+                        rules=("env-knob",))
+    assert not findings, "\n".join(
+        str(f) + "; route new knobs through etcd_tpu.utils.knobs"
+        for f in findings)
